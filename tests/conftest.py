@@ -1,0 +1,43 @@
+"""Test harness: simulate an 8-device mesh on CPU.
+
+Mirrors SURVEY.md section 4's rebuild strategy: all sharding/collective logic
+is unit-testable without TPUs via xla_force_host_platform_device_count.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Golden tests compare XLA ops against naive numpy: use full fp32 matmuls.
+# Production code keeps JAX's fast default (bf16-on-MXU) — see bench.py.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_prng():
+    """Reseed the named-generator registry per test for reproducibility."""
+    from znicz_tpu.core import prng
+
+    prng.reset()
+    prng.seed_all(1234)
+    yield
+    prng.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config():
+    from znicz_tpu.core.config import root
+
+    saved = root.to_dict()
+    yield
+    root.clear()
+    root.update(saved)

@@ -1,0 +1,66 @@
+"""Core layer tests: config tree, PRNG registry, logger."""
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import Config, root
+
+
+class TestConfig:
+    def test_autovivify(self):
+        cfg = Config()
+        cfg.mnist.learning_rate = 0.03
+        assert cfg.mnist.learning_rate == 0.03
+        assert cfg.to_dict() == {"mnist": {"learning_rate": 0.03}}
+
+    def test_deep_update(self):
+        cfg = Config()
+        cfg.update({"a": {"b": 1, "c": 2}})
+        cfg.update({"a": {"c": 3}, "d": 4})
+        assert cfg.to_dict() == {"a": {"b": 1, "c": 3}, "d": 4}
+
+    def test_get_nonvivifying(self):
+        cfg = Config()
+        assert cfg.get("missing", 42) == 42
+        assert "missing" not in cfg.to_dict()
+
+    def test_global_root(self):
+        root.update({"test_marker": {"x": 1}})
+        assert root.test_marker.x == 1
+
+    def test_mapping_access(self):
+        cfg = Config()
+        cfg["k"] = 5
+        assert cfg["k"] == 5
+        assert "k" in cfg
+
+
+class TestPrng:
+    def test_named_generators_deterministic(self):
+        prng.seed_all(77)
+        a = prng.get("w").normal((4, 4))
+        prng.seed_all(77)
+        b = prng.get("w").normal((4, 4))
+        np.testing.assert_array_equal(a, b)
+
+    def test_streams_decorrelated(self):
+        prng.seed_all(77)
+        a = prng.get("w").normal((100,))
+        b = prng.get("b").normal((100,))
+        assert abs(np.corrcoef(a, b)[0, 1]) < 0.5
+
+    def test_jax_keys_advance(self):
+        import jax.random
+
+        g = prng.get("default")
+        k1, k2 = g.key(), g.key()
+        assert not np.array_equal(
+            np.asarray(jax.random.key_data(k1)), np.asarray(jax.random.key_data(k2))
+        )
+
+    def test_permutation_reproducible(self):
+        prng.seed_all(5)
+        p1 = prng.get("loader").permutation(10)
+        prng.seed_all(5)
+        p2 = prng.get("loader").permutation(10)
+        np.testing.assert_array_equal(p1, p2)

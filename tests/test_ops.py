@@ -1,0 +1,316 @@
+"""Op-level golden tests: each op vs a naive numpy implementation.
+
+This is the rebuild of the reference's cross-backend unit tests
+(znicz/tests/unit/test_*.py, SURVEY.md section 4): the naive numpy loops below
+play the role of numpy_run; the jnp/XLA ops must match within tolerance, and
+gradients are finite-difference checked.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from znicz_tpu.ops import (
+    activation,
+    all2all,
+    conv,
+    cutter,
+    deconv,
+    dropout,
+    kohonen,
+    normalization,
+    pooling,
+    rbm,
+)
+
+RTOL, ATOL = 1e-5, 1e-5
+
+
+def rand(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+
+
+class TestActivation:
+    def test_tanh_scaled(self):
+        x = rand(8)
+        np.testing.assert_allclose(
+            activation.tanh(x), 1.7159 * np.tanh(0.6666 * x), rtol=1e-4, atol=1e-5
+        )
+
+    def test_relu_is_softplus(self):
+        x = rand(8)
+        np.testing.assert_allclose(
+            activation.relu(x), np.log1p(np.exp(x)), rtol=1e-4
+        )
+
+    def test_strict_relu(self):
+        x = rand(8)
+        np.testing.assert_allclose(activation.strict_relu(x), np.maximum(x, 0))
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            activation.get("nope")
+
+
+class TestAll2All:
+    def test_forward_matches_numpy(self):
+        params = all2all.init_params(10, 5)
+        x = rand(4, 10)
+        got = all2all.apply(params, x)
+        want = x @ np.asarray(params["weights"]) + np.asarray(params["bias"])
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_flattens_input(self):
+        params = all2all.init_params(12, 3)
+        x = rand(2, 2, 3, 2)
+        assert all2all.apply(params, x).shape == (2, 3)
+
+    def test_softmax_rows_sum_to_one(self):
+        params = all2all.init_params(10, 7)
+        y = all2all.softmax_apply(params, rand(4, 10))
+        np.testing.assert_allclose(np.sum(np.asarray(y), axis=1), 1.0, rtol=1e-4)
+
+    def test_grad_finite_difference(self):
+        params = all2all.init_params(6, 4)
+        x = jnp.asarray(rand(3, 6))
+
+        def loss(w):
+            return jnp.sum(
+                jnp.square(all2all.apply({"weights": w, "bias": params["bias"]}, x))
+            )
+
+        g = jax.grad(loss)(params["weights"])
+        eps = 1e-3
+        w0 = np.asarray(params["weights"]).copy()
+        for idx in [(0, 0), (3, 2)]:
+            wp, wm = w0.copy(), w0.copy()
+            wp[idx] += eps
+            wm[idx] -= eps
+            num = (loss(jnp.asarray(wp)) - loss(jnp.asarray(wm))) / (2 * eps)
+            np.testing.assert_allclose(g[idx], num, rtol=1e-2)
+
+
+def naive_conv(x, w, b, stride=(1, 1)):
+    n, h, wdt, cin = x.shape
+    ky, kx, _, cout = w.shape
+    oh = (h - ky) // stride[0] + 1
+    ow = (wdt - kx) // stride[1] + 1
+    out = np.zeros((n, oh, ow, cout), np.float32)
+    for bi in range(n):
+        for i in range(oh):
+            for j in range(ow):
+                patch = x[
+                    bi, i * stride[0] : i * stride[0] + ky, j * stride[1] : j * stride[1] + kx
+                ]
+                out[bi, i, j] = np.tensordot(patch, w, axes=3) + b
+    return out
+
+
+class TestConv:
+    def test_forward_matches_naive(self):
+        params = conv.init_params(3, 4, kx=3, ky=3)
+        x = rand(2, 8, 8, 3)
+        got = conv.apply(params, x)
+        want = naive_conv(x, np.asarray(params["weights"]), np.asarray(params["bias"]))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_strided_padded_shape(self):
+        params = conv.init_params(3, 8, kx=5, ky=5)
+        x = rand(2, 16, 16, 3)
+        y = conv.apply(params, x, sliding=(2, 2), padding=(2, 2, 2, 2))
+        assert y.shape == conv.output_shape(x.shape, 8, 5, 5, (2, 2), (2, 2, 2, 2))
+        assert y.shape == (2, 8, 8, 8)
+
+    def test_grad_runs(self):
+        params = conv.init_params(2, 3, kx=3, ky=3)
+        x = jnp.asarray(rand(1, 6, 6, 2))
+        g = jax.grad(lambda p: jnp.sum(jnp.square(conv.apply(p, x))))(params)
+        assert g["weights"].shape == params["weights"].shape
+        assert bool(jnp.any(g["weights"] != 0))
+
+
+class TestPooling:
+    def test_max_matches_naive(self):
+        x = rand(2, 6, 6, 3)
+        got = pooling.max_pool(x, 2, 2)
+        want = x.reshape(2, 3, 2, 3, 2, 3).max(axis=(2, 4))
+        np.testing.assert_allclose(got, want)
+
+    def test_avg_matches_naive(self):
+        x = rand(2, 6, 6, 3)
+        got = pooling.avg_pool(x, 2, 2)
+        want = x.reshape(2, 3, 2, 3, 2, 3).mean(axis=(2, 4))
+        np.testing.assert_allclose(got, want, rtol=RTOL)
+
+    def test_max_abs_keeps_sign(self):
+        x = np.array([[[[-5.0], [1.0]], [[2.0], [3.0]]]], np.float32)
+        got = pooling.max_abs_pool(x, 2, 2)
+        assert got.reshape(()) == -5.0
+
+    def test_max_with_offset_roundtrip(self):
+        x = rand(2, 4, 4, 3)
+        vals, offset = pooling.max_pool_with_offset(x, 2, 2)
+        np.testing.assert_allclose(vals, pooling.max_pool(x, 2, 2))
+        up = deconv.depool_with_offset(vals, offset, x.shape)
+        # scattered values appear exactly at argmax positions
+        mask = np.asarray(up) != 0
+        np.testing.assert_allclose(np.asarray(up)[mask], np.asarray(x)[mask])
+
+    def test_stochastic_eval_is_expectation(self):
+        x = np.abs(rand(1, 4, 4, 2)) + 0.1
+        got = pooling.stochastic_pool(x, 2, 2, train=False)
+        p = x.reshape(1, 2, 2, 2, 2, 2)
+        # windows: axes 2,4
+        flat = np.moveaxis(p, (2, 4), (3, 4)).reshape(1, 2, 2, 4, 2)
+        probs = flat / flat.sum(axis=3, keepdims=True)
+        want = (probs * flat).sum(axis=3)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_stochastic_all_negative_window_falls_back_to_max_abs(self):
+        x = np.array([[[[-5.0], [-1.0]], [[-2.0], [-3.0]]]], np.float32)
+        got = pooling.stochastic_pool(x, 2, 2, rng=jax.random.key(0), train=True)
+        assert float(got.reshape(())) == -5.0
+
+    def test_stochastic_train_picks_window_members(self):
+        x = np.abs(rand(1, 4, 4, 1)) + 0.1
+        got = np.asarray(
+            pooling.stochastic_pool(x, 2, 2, rng=jax.random.key(0), train=True)
+        )
+        flat = np.moveaxis(x.reshape(1, 2, 2, 2, 2, 1), (2, 4), (3, 4)).reshape(
+            1, 2, 2, 4, 1
+        )
+        for i in range(2):
+            for j in range(2):
+                assert got[0, i, j, 0] in flat[0, i, j, :, 0]
+
+
+class TestLRN:
+    def test_matches_naive(self):
+        x = rand(2, 3, 3, 8)
+        got = normalization.lrn(x, alpha=1e-4, beta=0.75, k=2.0, n=5)
+        want = np.empty_like(x)
+        for c in range(8):
+            lo, hi = max(0, c - 2), min(8, c + 3)
+            s = np.sum(np.square(x[..., lo:hi]), axis=-1)
+            want[..., c] = x[..., c] / np.power(2.0 + 1e-4 * s, 0.75)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_grad_finite(self):
+        x = jnp.asarray(rand(1, 2, 2, 6))
+        g = jax.grad(lambda t: jnp.sum(jnp.square(normalization.lrn(t))))(x)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+class TestDropoutCutter:
+    def test_dropout_eval_identity(self):
+        x = rand(4, 10)
+        np.testing.assert_array_equal(
+            dropout.dropout(x, dropout_ratio=0.5, train=False), x
+        )
+
+    def test_dropout_preserves_mean(self):
+        x = np.ones((100, 100), np.float32)
+        y = dropout.dropout(
+            x, dropout_ratio=0.3, rng=jax.random.key(0), train=True
+        )
+        assert abs(float(jnp.mean(y)) - 1.0) < 0.05
+
+    def test_cutter(self):
+        x = rand(1, 6, 8, 2)
+        y = cutter.cut(x, (1, 2, 3, 0))
+        assert y.shape == cutter.output_shape(x.shape, (1, 2, 3, 0)) == (1, 4, 4, 2)
+        np.testing.assert_array_equal(y, x[:, 2:6, 1:5, :])
+
+
+class TestDeconv:
+    def test_adjoint_of_conv(self):
+        """<conv(x), y> == <x, deconv(y)> with shared weights — exact adjoint."""
+        params = conv.init_params(2, 3, kx=3, ky=3)
+        dparams = {"weights": params["weights"]}
+        x = jnp.asarray(rand(1, 6, 6, 2, seed=1))
+        y = jnp.asarray(rand(1, 4, 4, 3, seed=2))
+        fwd = conv.apply(params, x) - params["bias"]
+        back = deconv.apply(dparams, y)
+        lhs = float(jnp.sum(fwd * y))
+        rhs = float(jnp.sum(x * back))
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+    def test_upsample(self):
+        y = rand(1, 2, 2, 1)
+        up = deconv.upsample(y, 2, 2)
+        assert up.shape == (1, 4, 4, 1)
+        np.testing.assert_allclose(up[0, :2, :2, 0], y[0, 0, 0, 0])
+
+
+class TestKohonen:
+    def test_winner_matches_naive(self):
+        params = kohonen.init_params(4, 4, 8)
+        x = rand(10, 8)
+        got = np.asarray(kohonen.winners(params, x))
+        w = np.asarray(params["weights"])
+        want = np.argmin(
+            np.sum((x[:, None, :] - w[None, :, :]) ** 2, axis=2), axis=1
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_train_moves_winner_toward_sample(self):
+        params = kohonen.init_params(3, 3, 4)
+        coords = kohonen.grid_coords(3, 3)
+        x = np.abs(rand(1, 4)) + 1.0
+        win0 = int(kohonen.winners(params, jnp.asarray(x))[0])
+        d0 = np.linalg.norm(np.asarray(params["weights"])[win0] - x[0])
+        new, win = kohonen.train_step(
+            params,
+            jnp.asarray(x),
+            coords,
+            learning_rate=jnp.float32(0.5),
+            sigma=jnp.float32(1.0),
+        )
+        assert int(win[0]) == win0
+        d1 = np.linalg.norm(np.asarray(new["weights"])[win0] - x[0])
+        assert d1 < d0
+
+    def test_convergence_on_clusters(self):
+        """SOM should land units near two well-separated clusters."""
+        rng = np.random.default_rng(0)
+        a = rng.normal(0, 0.05, (50, 2)) + np.array([1.0, 0.0])
+        b = rng.normal(0, 0.05, (50, 2)) + np.array([-1.0, 0.0])
+        data = np.concatenate([a, b]).astype(np.float32)
+        params = kohonen.init_params(4, 4, 2, weights_stddev=0.1)
+        coords = kohonen.grid_coords(4, 4)
+        step = jax.jit(
+            lambda p, x, lr, s: kohonen.train_step(
+                p, x, coords, learning_rate=lr, sigma=s
+            )[0]
+        )
+        for i in range(100):
+            lr, sigma = kohonen.decay_schedule(i, 100, sx=4, sy=4, sigma1=0.3)
+            params = step(params, jnp.asarray(data), jnp.float32(lr), jnp.float32(sigma))
+        w = np.asarray(params["weights"])
+        d_a = np.min(np.linalg.norm(w - np.array([1.0, 0.0]), axis=1))
+        d_b = np.min(np.linalg.norm(w - np.array([-1.0, 0.0]), axis=1))
+        assert d_a < 0.25 and d_b < 0.25
+
+
+class TestRBM:
+    def test_cd_reduces_reconstruction_error(self):
+        prngs = np.random.default_rng(0)
+        data = (prngs.uniform(size=(64, 16)) < 0.3).astype(np.float32)
+        params = rbm.init_params(16, 8)
+        step = jax.jit(
+            lambda p, k: rbm.cd_step(p, jnp.asarray(data), k, learning_rate=0.5)
+        )
+        key = jax.random.key(0)
+        errs = []
+        for i in range(40):
+            key, sub = jax.random.split(key)
+            params, err = step(params, sub)
+            errs.append(float(err))
+        assert np.mean(errs[-5:]) < np.mean(errs[:5])
+
+    def test_probs_in_range(self):
+        params = rbm.init_params(10, 6)
+        v = (rand(4, 10) > 0).astype(np.float32)
+        h = np.asarray(rbm.hidden_probs(params, v))
+        assert np.all(h >= 0) and np.all(h <= 1)

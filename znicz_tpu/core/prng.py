@@ -1,0 +1,106 @@
+"""Named, deterministically seeded random generator registry.
+
+Capability parity with ``veles/prng/`` [SURVEY.md 2.1 "PRNG"]: generators are
+shared *by name* so that weight init, shuffling and dropout are reproducible
+across runs and backends.  TPU-native twist: each generator owns a
+``jax.random`` key and hands out fresh subkeys; inside jitted code keys are
+threaded explicitly (they live in the train state), while host-side users
+(weight init, loader shuffling) call the stateful convenience methods here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+class RandomGenerator:
+    """A named stateful wrapper over a jax PRNG key chain."""
+
+    def __init__(self, name: str, seed: Optional[int] = None):
+        self.name = name
+        self._seed = None
+        self._key = None
+        self._numpy = None
+        self.seed(seed if seed is not None else _default_seed(name))
+
+    def seed(self, value: int) -> None:
+        self._seed = int(value)
+        self._key = jax.random.key(self._seed)
+        self._numpy = np.random.default_rng(self._seed)
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def key(self) -> jax.Array:
+        """Return a fresh subkey; advances internal state."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def keys(self, n: int) -> jax.Array:
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return jax.numpy.stack(subs)
+
+    # -- host-side conveniences (numpy outputs, used outside jit) ---------
+    def normal(self, shape, mean=0.0, stddev=1.0, dtype=np.float32) -> np.ndarray:
+        return (self._numpy.standard_normal(shape) * stddev + mean).astype(dtype)
+
+    def uniform(self, shape, low=-1.0, high=1.0, dtype=np.float32) -> np.ndarray:
+        return self._numpy.uniform(low, high, shape).astype(dtype)
+
+    def permutation(self, n: int) -> np.ndarray:
+        return self._numpy.permutation(n)
+
+    def integers(self, low, high, shape=()) -> np.ndarray:
+        return self._numpy.integers(low, high, shape)
+
+
+_registry: Dict[str, RandomGenerator] = {}
+_global_seed: Optional[int] = None
+
+
+def _default_seed(name: str) -> int:
+    # Stable cross-process default derived from the generator name; if a
+    # global seed was set (``--random-seed``), derive from it so generators
+    # created after seed_all() are seeded consistently with existing ones.
+    if _global_seed is not None:
+        return (_global_seed ^ hash_name(name)) % (2**31)
+    return abs(hash_name(name)) % (2**31)
+
+
+def hash_name(name: str) -> int:
+    h = 0
+    for ch in name:
+        h = (h * 31 + ord(ch)) & 0x7FFFFFFF
+    return h
+
+
+def get(name: str = "default") -> RandomGenerator:
+    """Return the shared generator registered under ``name`` (creating it)."""
+    gen = _registry.get(name)
+    if gen is None:
+        gen = RandomGenerator(name)
+        _registry[name] = gen
+    return gen
+
+
+def seed_all(seed: int) -> None:
+    """Reseed every generator (current and future) from one master seed.
+
+    Mirrors the reference's ``--random-seed`` flag behaviour: generator
+    ``name`` gets ``seed ^ hash(name)`` so streams stay decorrelated.
+    """
+    global _global_seed
+    _global_seed = int(seed)
+    for name, gen in _registry.items():
+        gen.seed((seed ^ hash_name(name)) % (2**31))
+
+
+def reset() -> None:
+    """Drop all registered generators and the global seed (test isolation)."""
+    global _global_seed
+    _global_seed = None
+    _registry.clear()

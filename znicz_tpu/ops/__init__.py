@@ -1,0 +1,23 @@
+"""Functional NN ops: the TPU-native equivalents of the reference's op units.
+
+Each reference forward/backward unit pair (znicz/all2all.py + znicz/gd.py,
+znicz/conv.py + znicz/gd_conv.py, ... per SURVEY.md section 2.2) collapses to a
+single pure forward function here: the backward pass is JAX autodiff, and the
+explicit update rules (learning rate, gradient_moment momentum, weights_decay)
+live in :mod:`znicz_tpu.nn.optimizer`.
+
+Every op has a plain-jnp implementation (the new "numpy_run" reference twin);
+hot ops additionally get Pallas TPU kernels under ``znicz_tpu/ops/pallas/``,
+cross-checked against the jnp versions in tests (SURVEY.md section 4).
+"""
+
+from znicz_tpu.ops import activation  # noqa: F401
+from znicz_tpu.ops import all2all  # noqa: F401
+from znicz_tpu.ops import conv  # noqa: F401
+from znicz_tpu.ops import cutter  # noqa: F401
+from znicz_tpu.ops import deconv  # noqa: F401
+from znicz_tpu.ops import dropout  # noqa: F401
+from znicz_tpu.ops import kohonen  # noqa: F401
+from znicz_tpu.ops import normalization  # noqa: F401
+from znicz_tpu.ops import pooling  # noqa: F401
+from znicz_tpu.ops import rbm  # noqa: F401

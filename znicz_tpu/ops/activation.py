@@ -1,0 +1,72 @@
+"""Activation functions.
+
+Capability parity with ``znicz/activation.py`` [SURVEY.md 2.2 "Activations"].
+The reference's naming is kept, including its idiosyncrasies:
+
+* ``tanh`` is the scaled LeCun tanh ``1.7159 * tanh(2/3 x)`` used by the
+  ``*Tanh`` units.
+* ``relu`` is the reference's smooth variant ``log(1 + exp(x))`` (softplus);
+  ``strict_relu`` is the usual ``max(x, 0)``.
+* ``log`` is ``log(x + sqrt(x^2 + 1))`` (asinh-style) [med confidence].
+* ``mul`` multiplies two tensors elementwise (ActivationMul).
+
+Backward passes come from autodiff — there are no hand-written GD twins.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+TANH_A = 1.7159
+TANH_B = 0.6666
+
+
+def tanh(x: jnp.ndarray) -> jnp.ndarray:
+    return TANH_A * jnp.tanh(TANH_B * x)
+
+
+def relu(x: jnp.ndarray) -> jnp.ndarray:
+    """Reference 'RELU': smooth softplus log(1+exp(x))."""
+    return jnp.logaddexp(x, 0.0)
+
+
+def strict_relu(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(x, 0.0)
+
+
+def sigmoid(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.sigmoid(x)
+
+
+def log(x: jnp.ndarray) -> jnp.ndarray:
+    # log(x + sqrt(x^2 + 1)) == asinh(x); jnp.arcsinh avoids the fp32
+    # catastrophic cancellation of the literal formula for large negative x.
+    return jnp.arcsinh(x)
+
+
+def mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return x * y
+
+
+def linear(x: jnp.ndarray) -> jnp.ndarray:
+    return x
+
+
+ACTIVATIONS = {
+    "linear": linear,
+    "tanh": tanh,
+    "relu": relu,
+    "strict_relu": strict_relu,
+    "sigmoid": sigmoid,
+    "log": log,
+}
+
+
+def get(name: str):
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(ACTIVATIONS)}"
+        ) from None

@@ -1,0 +1,94 @@
+"""Fully-connected (All2All) op.
+
+Capability parity with ``znicz/all2all.py`` (All2All, All2AllTanh, All2AllRELU,
+All2AllSigmoid, All2AllSoftmax) and its backward twin ``znicz/gd.py``
+[SURVEY.md 2.2 row "Fully connected"].  TPU-native: one ``dot_general`` on the
+MXU; the activation fuses into the matmul under XLA.  Backward is autodiff.
+
+Weights layout is ``[n_input, n_output]`` (MXU-friendly, contrasting the
+reference's ``output = x . W^T``); init matches the reference's uniform /
+gaussian fill from the shared named PRNG [SURVEY.md 2.3 "NN unit bases"].
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.ops import activation as act
+
+
+def init_params(
+    n_input: int,
+    n_output: int,
+    *,
+    weights_stddev: Optional[float] = None,
+    bias_stddev: Optional[float] = None,
+    weights_filling: str = "uniform",
+    bias_filling: str = "uniform",
+    rand_name: str = "default",
+    dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    """Initialize FC params from the shared named generator.
+
+    Default stddev mirrors the reference heuristic ``1/sqrt(fan_in)``.
+    """
+    gen = prng.get(rand_name)
+    if weights_stddev is None:
+        weights_stddev = 1.0 / np.sqrt(n_input)
+    if bias_stddev is None:
+        bias_stddev = weights_stddev
+    shape = (n_input, n_output)
+    if weights_filling == "uniform":
+        w = gen.uniform(shape, -weights_stddev, weights_stddev)
+    elif weights_filling == "gaussian":
+        w = gen.normal(shape, 0.0, weights_stddev)
+    elif weights_filling == "constant":
+        w = np.full(shape, weights_stddev, np.float32)
+    else:
+        raise ValueError(f"unknown weights_filling {weights_filling!r}")
+    if bias_filling == "uniform":
+        b = gen.uniform((n_output,), -bias_stddev, bias_stddev)
+    elif bias_filling == "gaussian":
+        b = gen.normal((n_output,), 0.0, bias_stddev)
+    elif bias_filling == "constant":
+        b = np.full((n_output,), bias_stddev, np.float32)
+    else:
+        raise ValueError(f"unknown bias_filling {bias_filling!r}")
+    return {"weights": jnp.asarray(w, dtype), "bias": jnp.asarray(b, dtype)}
+
+
+def apply(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    *,
+    activation: str = "linear",
+    include_bias: bool = True,
+) -> jnp.ndarray:
+    """Forward: flatten trailing dims, matmul on the MXU, apply activation."""
+    n_in = params["weights"].shape[0]
+    x = x.reshape(x.shape[0], n_in)
+    y = jnp.dot(x, params["weights"], preferred_element_type=jnp.float32)
+    if include_bias:
+        y = y + params["bias"]
+    return act.get(activation)(y)
+
+
+def softmax_apply(
+    params: Dict[str, jnp.ndarray], x: jnp.ndarray, *, include_bias: bool = True
+) -> jnp.ndarray:
+    """All2AllSoftmax: FC followed by a numerically-stable softmax.
+
+    The reference computes max-subtracted exp on device (softmax.cl/.cu);
+    XLA fuses the same pattern from this composition.
+    """
+    logits = apply(params, x, activation="linear", include_bias=include_bias)
+    return jnp.exp(log_softmax(logits))
+
+
+def log_softmax(logits: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.log_softmax(logits, axis=-1)

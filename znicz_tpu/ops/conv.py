@@ -1,0 +1,112 @@
+"""2-D convolution op.
+
+Capability parity with ``znicz/conv.py`` (Conv, ConvTanh, ConvRELU,
+ConvStrictRELU) + ``znicz/gd_conv.py`` [SURVEY.md 2.2 row "Convolution"].
+TPU-native: ``lax.conv_general_dilated`` in NHWC/HWIO layout so XLA tiles the
+contraction onto the MXU; backward (input + weight gradients, the reference's
+hand-written gradient_descent_conv kernels) is autodiff.
+
+Reference parameter names are kept: ``n_kernels``, ``kx``/``ky`` (kernel
+width/height), ``sliding`` (strides), ``padding`` (explicit 4-tuple
+left/top/right/bottom).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.ops import activation as act
+
+DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
+
+
+def init_params(
+    n_channels: int,
+    n_kernels: int,
+    kx: int,
+    ky: int,
+    *,
+    weights_stddev: Optional[float] = None,
+    bias_stddev: Optional[float] = None,
+    weights_filling: str = "uniform",
+    rand_name: str = "default",
+    dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    gen = prng.get(rand_name)
+    fan_in = kx * ky * n_channels
+    if weights_stddev is None:
+        weights_stddev = 1.0 / np.sqrt(fan_in)
+    if bias_stddev is None:
+        bias_stddev = weights_stddev
+    shape = (ky, kx, n_channels, n_kernels)
+    if weights_filling == "uniform":
+        w = gen.uniform(shape, -weights_stddev, weights_stddev)
+    elif weights_filling == "gaussian":
+        w = gen.normal(shape, 0.0, weights_stddev)
+    else:
+        raise ValueError(f"unknown weights_filling {weights_filling!r}")
+    b = gen.uniform((n_kernels,), -bias_stddev, bias_stddev)
+    return {"weights": jnp.asarray(w, dtype), "bias": jnp.asarray(b, dtype)}
+
+
+def _norm_padding(padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """Reference 4-tuple (left, top, right, bottom) -> lax ((t,b),(l,r))."""
+    if isinstance(padding, str):
+        return padding  # "SAME"/"VALID" pass through
+    if len(padding) == 2:
+        return ((padding[1], padding[1]), (padding[0], padding[0]))
+    left, top, right, bottom = padding
+    return ((top, bottom), (left, right))
+
+
+def apply(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    *,
+    sliding: Sequence[int] = (1, 1),
+    padding=(0, 0, 0, 0),
+    activation: str = "linear",
+) -> jnp.ndarray:
+    """Forward conv, NHWC.  ``sliding`` is (sx, sy) per the reference."""
+    pad = _norm_padding(padding)
+    strides = (sliding[1], sliding[0])  # (sy, sx) -> spatial order (H, W)
+    y = lax.conv_general_dilated(
+        x,
+        params["weights"],
+        window_strides=strides,
+        padding=pad,
+        dimension_numbers=DIMENSION_NUMBERS,
+        preferred_element_type=jnp.float32,
+    )
+    y = y + params["bias"]
+    return act.get(activation)(y)
+
+
+def output_shape(
+    in_shape: Tuple[int, ...],
+    n_kernels: int,
+    kx: int,
+    ky: int,
+    sliding: Sequence[int] = (1, 1),
+    padding=(0, 0, 0, 0),
+) -> Tuple[int, ...]:
+    n, h, w, _ = in_shape
+    if isinstance(padding, str):
+        if padding == "SAME":
+            oh = -(-h // sliding[1])
+            ow = -(-w // sliding[0])
+        else:
+            oh = (h - ky) // sliding[1] + 1
+            ow = (w - kx) // sliding[0] + 1
+    else:
+        if len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        left, top, right, bottom = padding
+        oh = (h + top + bottom - ky) // sliding[1] + 1
+        ow = (w + left + right - kx) // sliding[0] + 1
+    return (n, oh, ow, n_kernels)

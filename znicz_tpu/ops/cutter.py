@@ -1,0 +1,24 @@
+"""Cutter: crop a spatial region out of an NHWC tensor.
+
+Capability parity with ``znicz/cutter.py`` [SURVEY.md 2.2 row "Input
+cutter/crop"].  Forward is a static slice; the backward (zero-padding the
+gradient back to the input shape, the reference's cutter gradient kernel) is
+autodiff.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cut(x: jnp.ndarray, padding) -> jnp.ndarray:
+    """Crop using the reference 4-tuple (left, top, right, bottom)."""
+    left, top, right, bottom = padding
+    h, w = x.shape[1], x.shape[2]
+    return x[:, top : h - bottom, left : w - right, :]
+
+
+def output_shape(in_shape, padding):
+    left, top, right, bottom = padding
+    n, h, w, c = in_shape
+    return (n, h - top - bottom, w - left - right, c)

@@ -1,0 +1,129 @@
+"""Deconvolution (transposed conv) and depooling — the autoencoder path.
+
+Capability parity with ``znicz/deconv.py`` (Deconv), ``znicz/gd_deconv.py``
+(GDDeconv) and ``znicz/depooling.py`` (Depooling) [SURVEY.md 2.2 row
+"Deconv / unpooling (AE path)"].
+
+TPU-native: deconv is ``conv_general_dilated`` with lhs dilation (the exact
+adjoint of the forward conv, so an AE's decoder mirrors its encoder); both
+weight gradients come from autodiff.  Depooling supports the reference's
+offset-driven unpooling (scatter values back to max positions recorded by
+``pooling.max_pool_with_offset``) plus plain nearest-neighbor upsampling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from znicz_tpu.ops import activation as act
+from znicz_tpu.ops import conv as conv_op
+
+
+def init_params(
+    n_channels: int,
+    n_kernels: int,
+    kx: int,
+    ky: int,
+    *,
+    weights_stddev: float | None = None,
+    weights_filling: str = "uniform",
+    rand_name: str = "default",
+    dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    """Deconv weights have conv layout [ky, kx, out_channels, in_kernels].
+
+    ``n_kernels`` is the deconv *input* channel count (mirroring the conv it
+    inverts); ``n_channels`` is the reconstructed output channel count, so
+    fan-in is ``kx*ky*n_kernels``.  The reference Deconv has no bias; params
+    are drawn directly (exactly one draw from the named generator) so the
+    deterministic PRNG stream stays aligned with the reference contract.
+    """
+    from znicz_tpu.core import prng
+    import numpy as np
+
+    gen = prng.get(rand_name)
+    if weights_stddev is None:
+        weights_stddev = 1.0 / np.sqrt(kx * ky * n_kernels)
+    shape = (ky, kx, n_channels, n_kernels)
+    if weights_filling == "uniform":
+        w = gen.uniform(shape, -weights_stddev, weights_stddev)
+    elif weights_filling == "gaussian":
+        w = gen.normal(shape, 0.0, weights_stddev)
+    else:
+        raise ValueError(f"unknown weights_filling {weights_filling!r}")
+    return {"weights": jnp.asarray(w, dtype)}
+
+
+def apply(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    *,
+    sliding: Sequence[int] = (1, 1),
+    padding=(0, 0, 0, 0),
+    output_size: Tuple[int, int] | None = None,
+    activation: str = "linear",
+) -> jnp.ndarray:
+    """Transposed conv: the exact adjoint of ``conv.apply`` with the same
+    params, ``sliding`` and ``padding`` (the reference Deconv derives its
+    geometry from the conv it mirrors via ``get_output_shape_from``).
+
+    ``output_size`` is the (H, W) of the reconstructed tensor; when omitted it
+    is taken as the minimal exact inverse of the mirrored conv.
+    """
+    w = params["weights"]  # [ky, kx, C_out_of_deconv, K_in]
+    ky, kx = w.shape[0], w.shape[1]
+    if isinstance(padding, str):
+        raise ValueError("deconv needs explicit reference-style padding")
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    sy, sx = sliding[1], sliding[0]
+    oh, ow = x.shape[1], x.shape[2]
+    if output_size is None:
+        output_size = (
+            (oh - 1) * sy + ky - top - bottom,
+            (ow - 1) * sx + kx - left - right,
+        )
+    h, w_out = output_size
+    # Adjoint of conv: dilate by stride, pad (k-1-p_lo, H+p_lo-(OH-1)s-1),
+    # convolve stride-1 with the spatially-flipped, channel-swapped kernel.
+    pad_h = (ky - 1 - top, h + top - (oh - 1) * sy - 1)
+    pad_w = (kx - 1 - left, w_out + left - (ow - 1) * sx - 1)
+    kernel = jnp.flip(w, axis=(0, 1)).transpose(0, 1, 3, 2)  # [ky,kx,K,C]
+    y = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding=(pad_h, pad_w),
+        lhs_dilation=(sy, sx),
+        dimension_numbers=conv_op.DIMENSION_NUMBERS,
+        preferred_element_type=jnp.float32,
+    )
+    return act.get(activation)(y)
+
+
+def depool_with_offset(
+    y: jnp.ndarray, offset: jnp.ndarray, out_shape: Tuple[int, ...]
+) -> jnp.ndarray:
+    """Scatter pooled values back to their argmax positions (znicz Depooling).
+
+    ``offset`` holds flat H*W input offsets per output element, as produced by
+    :func:`znicz_tpu.ops.pooling.max_pool_with_offset`.
+    """
+    n, h, w, c = out_shape
+    flat = jnp.zeros((n, h * w, c), y.dtype)
+    yf = y.reshape(n, -1, c)
+    of = offset.reshape(n, -1, c)
+    # one-step scatter-add per batch/channel via segment trick
+    flat = flat.at[jnp.arange(n)[:, None, None], of, jnp.arange(c)[None, None, :]].add(
+        yf
+    )
+    return flat.reshape(n, h, w, c)
+
+
+def upsample(y: jnp.ndarray, kx: int, ky: int) -> jnp.ndarray:
+    """Nearest-neighbor unpooling (avg-pool adjoint up to scale)."""
+    return jnp.repeat(jnp.repeat(y, ky, axis=1), kx, axis=2)

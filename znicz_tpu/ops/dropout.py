@@ -1,0 +1,28 @@
+"""Dropout.
+
+Capability parity with ``znicz/dropout.py`` (DropoutForward/DropoutBackward)
+[SURVEY.md 2.2 row "Dropout"].  Inverted dropout: surviving activations are
+scaled by ``1/(1-p)`` so eval is a no-op.  The RNG key is threaded explicitly
+(train-state keys), replacing the reference's named-generator device kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(
+    x: jnp.ndarray,
+    *,
+    dropout_ratio: float,
+    rng: jax.Array | None = None,
+    train: bool = True,
+) -> jnp.ndarray:
+    if not train or dropout_ratio <= 0.0:
+        return x
+    if rng is None:
+        raise ValueError("dropout(train=True) needs an rng key")
+    keep = 1.0 - dropout_ratio
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

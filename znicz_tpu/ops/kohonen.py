@@ -1,0 +1,95 @@
+"""Kohonen self-organizing map: forward (winner-take-all) + trainer rule.
+
+Capability parity with ``znicz/kohonen.py`` (KohonenForward, KohonenTrainer)
+[SURVEY.md 2.2 row "Kohonen SOM"].  This is the reference's flagship
+non-backprop unit — the learning rule *is* the trainer, there is no GD twin.
+
+TPU-native: winner search is one batched matmul (argmin ||x-w||^2 ==
+argmax(x.w - ||w||^2/2)) that rides the MXU, and the neighborhood update is a
+dense [map_size, batch] x [batch, features] matmul instead of the reference's
+scatter kernel — dense beats scatter on TPU.  The fused Pallas winner+update
+kernel lives under ``znicz_tpu/ops/pallas/``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+
+
+def init_params(
+    sx: int,
+    sy: int,
+    n_input: int,
+    *,
+    weights_stddev: float | None = None,
+    rand_name: str = "default",
+    dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    gen = prng.get(rand_name)
+    if weights_stddev is None:
+        weights_stddev = 1.0 / np.sqrt(n_input)
+    w = gen.uniform((sx * sy, n_input), -weights_stddev, weights_stddev)
+    return {"weights": jnp.asarray(w, dtype)}
+
+
+def grid_coords(sx: int, sy: int) -> jnp.ndarray:
+    """[sx*sy, 2] map-grid coordinates, row-major like the reference."""
+    ys, xs = jnp.meshgrid(jnp.arange(sy), jnp.arange(sx), indexing="ij")
+    return jnp.stack([xs.reshape(-1), ys.reshape(-1)], axis=1).astype(jnp.float32)
+
+
+def winners(params: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
+    """Forward: index of the closest map unit per sample.  [B] int32."""
+    w = params["weights"]
+    # argmin ||x - w||^2 over map units; expand via matmul for the MXU.
+    scores = x @ w.T - 0.5 * jnp.sum(jnp.square(w), axis=1)[None, :]
+    return jnp.argmax(scores, axis=1).astype(jnp.int32)
+
+
+def train_step(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    coords: jnp.ndarray,
+    *,
+    learning_rate: jnp.ndarray,
+    sigma: jnp.ndarray,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """One batch-SOM update; returns (new_params, winner indices).
+
+    Classical batch Kohonen rule with neighborhood
+    ``h_j(b) = exp(-d(j, u(b))^2 / (2 sigma^2))``:
+
+        w_j <- w_j + lr * (sum_b h_j(b) x_b / sum_b h_j(b) - w_j)
+
+    i.e. each unit relaxes toward the h-weighted mean of the samples in its
+    neighborhood (lr=1 gives the exact fixed-point batch SOM).  Computed
+    densely as two [M,B]x[B,F] matmuls on the MXU — dense beats the
+    reference's scatter kernel on TPU.
+    """
+    w = params["weights"]
+    win = winners(params, x)
+    d2 = jnp.sum(
+        jnp.square(coords[None, :, :] - coords[win][:, None, :]), axis=-1
+    )  # [B, M]
+    h = jnp.exp(-d2 / (2.0 * jnp.square(sigma)))  # [B, M]
+    num = h.T @ x  # [M, F]
+    denom = jnp.sum(h, axis=0)[:, None]  # [M, 1]
+    target = num / jnp.maximum(denom, 1e-12)
+    # Units with no neighborhood mass stay put.
+    delta = jnp.where(denom > 1e-8, learning_rate * (target - w), 0.0)
+    return {"weights": w + delta}, win
+
+
+def decay_schedule(step, total_steps, *, lr0=0.1, lr1=0.01, sigma0=None, sigma1=1.0, sx=8, sy=8):
+    """Reference-style time-decaying lr and neighborhood radius."""
+    if sigma0 is None:
+        sigma0 = max(sx, sy) / 2.0
+    frac = jnp.clip(step / jnp.maximum(total_steps, 1), 0.0, 1.0)
+    lr = lr0 * jnp.power(lr1 / lr0, frac)
+    sigma = sigma0 * jnp.power(sigma1 / sigma0, frac)
+    return lr, sigma

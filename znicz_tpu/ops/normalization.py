@@ -1,0 +1,48 @@
+"""Local response normalization (LRN) across channels.
+
+Capability parity with ``znicz/normalization.py`` (LRNormalizerForward /
+LRNormalizerBackward) [SURVEY.md 2.2 row "Local response norm"], the AlexNet
+cross-channel normalizer:
+
+    y_c = x_c / (k + alpha * sum_{c' in window(c)} x_{c'}^2) ** beta
+
+Reference parameter names kept: ``alpha``, ``beta``, ``k``, ``n`` (window
+size).  The jnp implementation below is the reference twin for the fused
+Pallas kernel under ``znicz_tpu/ops/pallas/``.  Backward is autodiff.
+"""
+
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+# znicz defaults (AlexNet-style).
+DEFAULT_ALPHA = 1e-4
+DEFAULT_BETA = 0.75
+DEFAULT_K = 2.0
+DEFAULT_N = 5
+
+
+def _window_sums(sq: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Sliding-window sum over the trailing channel axis, window n, SAME."""
+    half = n // 2
+    return lax.reduce_window(
+        sq,
+        0.0,
+        lax.add,
+        window_dimensions=(1,) * (sq.ndim - 1) + (n,),
+        window_strides=(1,) * sq.ndim,
+        padding=((0, 0),) * (sq.ndim - 1) + ((half, n - 1 - half),),
+    )
+
+
+def lrn(
+    x: jnp.ndarray,
+    *,
+    alpha: float = DEFAULT_ALPHA,
+    beta: float = DEFAULT_BETA,
+    k: float = DEFAULT_K,
+    n: int = DEFAULT_N,
+) -> jnp.ndarray:
+    sums = _window_sums(jnp.square(x), n)
+    return x * jnp.power(k + alpha * sums, -beta)

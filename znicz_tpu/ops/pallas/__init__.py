@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for hot ops.
+
+These are the TPU-native equivalents of the reference's hand-written
+``znicz/ocl/*.cl`` + ``znicz/cuda/*.cu`` kernel sets [SURVEY.md 2.4].  Every
+kernel here has a plain-jnp reference twin in :mod:`znicz_tpu.ops` and a
+cross-check test (the rebuild of the reference's numpy-vs-OpenCL-vs-CUDA
+golden tests, SURVEY.md section 4).
+
+Kernels fall back to the jnp twin on non-TPU backends so the whole framework
+runs on CPU (the reference's ``NumpyDevice`` everywhere-runnable property).
+"""

@@ -1,0 +1,133 @@
+"""Pooling ops: max, avg, max-abs, stochastic.
+
+Capability parity with ``znicz/pooling.py`` + ``znicz/gd_pooling.py``
+[SURVEY.md 2.2 row "Pooling"].  TPU-native: max/avg ride
+``lax.reduce_window`` (XLA lowers these to fused VPU loops); max-abs and
+stochastic pooling — which need per-window argmax/sampling — use an
+im2col-patch formulation that XLA tiles well.  Backward is autodiff
+(``reduce_window`` has an efficient XLA-defined gradient, replacing the
+reference's hand-written gradient_descent_pooling kernels).
+
+Max pooling can also return flat argmax offsets per output element
+(``max_with_offset``) — the reference stores these ``input_offset`` values to
+drive Depooling in the autoencoder path [SURVEY.md 2.2 "Deconv / unpooling"].
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def _window(kx: int, ky: int, sliding) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    if sliding is None:
+        sliding = (kx, ky)
+    return (1, ky, kx, 1), (1, sliding[1], sliding[0], 1)
+
+
+def max_pool(
+    x: jnp.ndarray, kx: int, ky: int, sliding: Sequence[int] | None = None
+) -> jnp.ndarray:
+    dims, strides = _window(kx, ky, sliding)
+    return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, "VALID")
+
+
+def avg_pool(
+    x: jnp.ndarray, kx: int, ky: int, sliding: Sequence[int] | None = None
+) -> jnp.ndarray:
+    dims, strides = _window(kx, ky, sliding)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, "VALID")
+    return summed / (kx * ky)
+
+
+def _patches(x: jnp.ndarray, kx: int, ky: int, sliding) -> jnp.ndarray:
+    """im2col: [N, OH, OW, ky*kx, C] view of pooling windows."""
+    if sliding is None:
+        sliding = (kx, ky)
+    n, h, w, c = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=(ky, kx),
+        window_strides=(sliding[1], sliding[0]),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    oh, ow = patches.shape[1], patches.shape[2]
+    # conv_general_dilated_patches yields channels ordered [C, ky, kx]
+    patches = patches.reshape(n, oh, ow, c, ky * kx)
+    return jnp.moveaxis(patches, -1, -2)  # [N, OH, OW, ky*kx, C]
+
+
+def max_abs_pool(
+    x: jnp.ndarray, kx: int, ky: int, sliding: Sequence[int] | None = None
+) -> jnp.ndarray:
+    """Select the element with the largest magnitude, keeping its sign."""
+    p = _patches(x, kx, ky, sliding)
+    idx = jnp.argmax(jnp.abs(p), axis=3, keepdims=True)
+    return jnp.take_along_axis(p, idx, axis=3)[..., 0, :]
+
+
+def max_pool_with_offset(
+    x: jnp.ndarray, kx: int, ky: int, sliding: Sequence[int] | None = None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Max pooling returning (values, flat input offsets) for depooling."""
+    if sliding is None:
+        sliding = (kx, ky)
+    n, h, w, c = x.shape
+    p = _patches(x, kx, ky, sliding)
+    idx = jnp.argmax(p, axis=3)  # [N, OH, OW, C] in-window index
+    vals = jnp.take_along_axis(p, idx[:, :, :, None, :], axis=3)[..., 0, :]
+    oh, ow = idx.shape[1], idx.shape[2]
+    # Decode in-window index -> absolute (row, col) -> flat offset in [H*W).
+    win_row, win_col = idx // kx, idx % kx
+    base_row = jnp.arange(oh)[None, :, None, None] * sliding[1]
+    base_col = jnp.arange(ow)[None, None, :, None] * sliding[0]
+    offset = (base_row + win_row) * w + (base_col + win_col)
+    return vals, offset
+
+
+def stochastic_pool(
+    x: jnp.ndarray,
+    kx: int,
+    ky: int,
+    sliding: Sequence[int] | None = None,
+    *,
+    rng: jax.Array | None = None,
+    train: bool = True,
+) -> jnp.ndarray:
+    """Stochastic pooling (Zeiler & Fergus style, znicz StochasticPooling).
+
+    Train: sample one element per window with probability proportional to its
+    positive activation.  Eval: probability-weighted expectation.
+    """
+    p = _patches(x, kx, ky, sliding)  # [N, OH, OW, K, C]
+    pos = jnp.maximum(p, 0.0)
+    total = jnp.sum(pos, axis=3, keepdims=True)
+    probs = jnp.where(total > 0, pos / jnp.maximum(total, 1e-30), 0.0)
+    if not train:
+        return jnp.sum(probs * p, axis=3)
+    if rng is None:
+        raise ValueError("stochastic_pool(train=True) needs an rng key")
+    # Gumbel-max over the window axis; windows with all-nonpositive values
+    # fall back to max-abs selection like the reference kernel.
+    g = jax.random.gumbel(rng, probs.shape, probs.dtype)
+    logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-30)), -jnp.inf)
+    scores = jnp.where(
+        jnp.broadcast_to(total > 0, probs.shape), logp + g, jnp.abs(p)
+    )
+    idx = jnp.argmax(scores, axis=3, keepdims=True)
+    return jnp.take_along_axis(p, idx, axis=3)[..., 0, :]
+
+
+def output_shape(
+    in_shape: Tuple[int, ...], kx: int, ky: int, sliding: Sequence[int] | None = None
+) -> Tuple[int, ...]:
+    if sliding is None:
+        sliding = (kx, ky)
+    n, h, w, c = in_shape
+    oh = (h - ky) // sliding[1] + 1
+    ow = (w - kx) // sliding[0] + 1
+    return (n, oh, ow, c)

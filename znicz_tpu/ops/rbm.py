@@ -1,0 +1,84 @@
+"""Bernoulli-Bernoulli restricted Boltzmann machine with CD-k training.
+
+Capability parity with ``znicz/rbm_units.py`` [SURVEY.md 2.2 row "RBM"]:
+visible/hidden Bernoulli units and contrastive-divergence updaters.  The
+learning rule is a custom update function (no autodiff), matching the
+reference's in-file updaters.  All sampling uses explicit jax keys.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+
+
+def init_params(
+    n_visible: int,
+    n_hidden: int,
+    *,
+    weights_stddev: float | None = None,
+    rand_name: str = "default",
+    dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    gen = prng.get(rand_name)
+    if weights_stddev is None:
+        weights_stddev = 1.0 / np.sqrt(n_visible)
+    return {
+        "weights": jnp.asarray(
+            gen.normal((n_visible, n_hidden), 0.0, weights_stddev), dtype
+        ),
+        "vbias": jnp.zeros((n_visible,), dtype),
+        "hbias": jnp.zeros((n_hidden,), dtype),
+    }
+
+
+def hidden_probs(params, v):
+    return jax.nn.sigmoid(v @ params["weights"] + params["hbias"])
+
+
+def visible_probs(params, h):
+    return jax.nn.sigmoid(h @ params["weights"].T + params["vbias"])
+
+
+def sample(rng, probs):
+    return jax.random.bernoulli(rng, probs).astype(probs.dtype)
+
+
+def cd_step(
+    params: Dict[str, jnp.ndarray],
+    v0: jnp.ndarray,
+    rng: jax.Array,
+    *,
+    learning_rate: float,
+    cd_k: int = 1,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """One CD-k update; returns (new_params, reconstruction error scalar)."""
+    batch = v0.shape[0]
+    h0_probs = hidden_probs(params, v0)
+
+    def gibbs(carry, key):
+        h_sample = carry
+        kv, kh = jax.random.split(key)
+        v_probs = visible_probs(params, h_sample)
+        v_sample = sample(kv, v_probs)
+        h_probs = hidden_probs(params, v_sample)
+        return sample(kh, h_probs), (v_probs, h_probs)
+
+    k0, *keys = jax.random.split(rng, cd_k + 1)
+    h0_sample = sample(k0, h0_probs)
+    _, (v_chain, h_chain) = jax.lax.scan(gibbs, h0_sample, jnp.stack(keys))
+    vk_probs, hk_probs = v_chain[-1], h_chain[-1]
+
+    lr = learning_rate / batch
+    new = {
+        "weights": params["weights"] + lr * (v0.T @ h0_probs - vk_probs.T @ hk_probs),
+        "vbias": params["vbias"] + lr * jnp.sum(v0 - vk_probs, axis=0),
+        "hbias": params["hbias"] + lr * jnp.sum(h0_probs - hk_probs, axis=0),
+    }
+    recon_err = jnp.mean(jnp.square(v0 - vk_probs))
+    return new, recon_err
