@@ -17,6 +17,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# The axon sitecustomize force-registers the TPU backend and sets
+# jax_platforms="axon,cpu" in every process, overriding the env var above —
+# override it back AFTER import so tests run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
 # Golden tests compare XLA ops against naive numpy: use full fp32 matmuls.
 # Production code keeps JAX's fast default (bf16-on-MXU) — see bench.py.
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,98 @@
+"""Genetic optimizer tests (veles --optimize parity)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.genetics import GeneticOptimizer, Tune, find_tunables
+
+
+class TestTunables:
+    def test_find_in_tree_and_layer_dicts(self):
+        root.g.update({"a": Tune(0.1, 0.0, 1.0), "nested": {"b": Tune(2, 1, 5, "int")}})
+        root.g.layers = [
+            {"type": "all2all", "<-": {"learning_rate": Tune(0.01, 1e-4, 1.0)}}
+        ]
+        found = find_tunables(root.g)
+        assert len(found) == 3
+        keys = {k for _, k, _ in found}
+        assert keys == {"a", "b", "learning_rate"}
+
+    def test_clip_kinds(self):
+        t = Tune(2, 1, 5, "int")
+        assert t.clip(7.6) == 5 and t.clip(0.2) == 1 and t.clip(3.4) == 3
+        f = Tune(0.1, 0.0, 1.0)
+        assert f.clip(2.0) == 1.0
+
+
+class TestGeneticOptimizer:
+    def test_minimizes_quadratic(self):
+        prng.seed_all(123)
+        root.q.update({"x": Tune(5.0, -10.0, 10.0), "y": Tune(-5.0, -10.0, 10.0)})
+        tunables = find_tunables(root.q)
+
+        def evaluate(genome):
+            x, y = genome
+            return (x - 3.0) ** 2 + (y + 1.0) ** 2
+
+        opt = GeneticOptimizer(
+            evaluate, tunables, population_size=12, mutation_rate=0.4
+        )
+        result = opt.run(generations=15)
+        assert result["best_fitness"] < 0.5
+        x, y = result["best_genome"]
+        assert abs(x - 3.0) < 1.0 and abs(y + 1.0) < 1.0
+        # apply_genome writes back into the config tree
+        opt.apply_genome(result["best_genome"])
+        assert root.q.x == x and root.q.y == y
+
+    def test_no_tunables_raises(self):
+        with pytest.raises(ValueError, match="no Tune leaves"):
+            GeneticOptimizer(lambda g: 0.0, [])
+
+    def test_deterministic_under_seed(self):
+        def run_once():
+            prng.reset()
+            prng.seed_all(7)
+            tunables = [({}, "x", Tune(0.0, -5.0, 5.0))]
+            opt = GeneticOptimizer(
+                lambda g: g[0] ** 2, tunables, population_size=6
+            )
+            return opt.run(generations=5)["best_fitness"]
+
+        assert run_once() == run_once()
+
+
+class TestOptimizeCLI:
+    def test_optimize_flag_end_to_end(self, tmp_path):
+        from znicz_tpu.launcher import run_args
+
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.core.config import root\n"
+            "from znicz_tpu.genetics import Tune\n"
+            "from znicz_tpu.models.wine import build_workflow\n"
+            "root.wine.layers = None  # use DEFAULTS, then tune lr below\n"
+            "import znicz_tpu.models.wine as wine\n"
+            "root.wine.update({'lr': Tune(0.3, 0.05, 0.5)})\n"
+            "def run(load, main):\n"
+            "    lr = root.wine.get('lr')\n"
+            "    layers = [dict(l) for l in wine.DEFAULTS['layers']]\n"
+            "    for l in layers:\n"
+            "        l['<-'] = {**l['<-'], 'learning_rate': lr}\n"
+            "    root.wine.layers = layers\n"
+            "    load(wine.build_workflow)\n"
+            "    main()\n"
+        )
+        launcher = run_args(
+            [
+                str(wf_py),
+                "--random-seed", "11",
+                "--stop-after", "2",
+                "--optimize", "2",
+            ]
+        )
+        assert launcher.result is not None
+        assert np.isfinite(launcher.result["best_fitness"])
+        assert len(launcher.result["history"]) == 2

@@ -1,0 +1,183 @@
+"""Model zoo + launcher CLI tests.
+
+Functional-test style per SURVEY.md §4: each sample workflow trains a couple
+of epochs under a fixed seed and must hit a tolerance band; the CLI drives a
+workflow module end-to-end with a config override file (the reference
+two-file UX, SURVEY.md 3.1).
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.launcher import run_args
+
+
+def _fresh(module):
+    """(Re)import a model module so its root defaults are applied."""
+    import importlib
+
+    mod = importlib.import_module(f"znicz_tpu.models.{module}")
+    return importlib.reload(mod)
+
+
+class TestModelZoo:
+    def test_wine_converges_to_zero_err(self):
+        prng.seed_all(1234)
+        wine = _fresh("wine")
+        root.wine.decision.update({"max_epochs": 30})
+        wf = wine.build_workflow()
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert dec.best_value == 0.0  # wine is linearly easy; reference too
+
+    def test_mnist_mlp(self):
+        prng.seed_all(1234)
+        mnist = _fresh("mnist")
+        root.mnist.loader.update({"n_train": 400, "n_test": 100})
+        root.mnist.decision.update({"max_epochs": 3})
+        wf = mnist.build_workflow()
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["err_pct"] < 15.0
+
+    def test_cifar_conv(self):
+        prng.seed_all(1234)
+        cifar = _fresh("cifar")
+        root.cifar.loader.update(
+            {"n_train": 200, "n_test": 50, "minibatch_size": 50}
+        )
+        root.cifar.decision.update({"max_epochs": 2})
+        wf = cifar.build_workflow()
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert np.isfinite(dec.history[-1]["train"]["loss"])
+        assert (
+            dec.history[-1]["train"]["loss"]
+            < dec.history[0]["train"]["loss"]
+        )
+
+    def test_mnist_ae(self):
+        prng.seed_all(1234)
+        ae = _fresh("mnist_ae")
+        root.mnist_ae.loader.update(
+            {"n_train": 200, "n_test": 0, "minibatch_size": 50}
+        )
+        root.mnist_ae.decision.update({"max_epochs": 3})
+        wf = ae.build_workflow()
+        assert wf.loss_function == "mse"
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert (
+            dec.history[-1]["train"]["loss"]
+            < dec.history[0]["train"]["loss"]
+        )
+
+    def test_kohonen_model(self):
+        prng.seed_all(1234)
+        km = _fresh("kohonen")
+        root.kohonen.loader.update({"n_train": 200, "n_test": 0})
+        wf = km.build_workflow(total_epochs=3)
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
+
+    def test_mnist_rbm_model(self):
+        prng.seed_all(1234)
+        rbm = _fresh("mnist_rbm")
+        root.mnist_rbm.loader.update({"n_train": 200, "n_test": 0})
+        wf = rbm.build_workflow(max_epochs=3, learning_rate=0.5)
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
+
+    def test_alexnet_builds(self):
+        # full run is the bench's job; here: builds + one forward shape check
+        prng.seed_all(1234)
+        alex = _fresh("alexnet")
+        root.alexnet.loader.update(
+            {"n_train": 4, "n_valid": 0, "minibatch_size": 4, "image_size": 227}
+        )
+        wf = alex.build_workflow()
+        import jax.numpy as jnp
+
+        y = wf.model.apply(wf.model.params, jnp.zeros((2, 227, 227, 3)))
+        assert y.shape == (2, 1000)
+
+
+class TestLauncherCLI:
+    def test_run_workflow_with_config_override(self, tmp_path):
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        cfg_py = tmp_path / "cfg.py"
+        cfg_py.write_text(
+            "from znicz_tpu.core.config import root\n"
+            "root.wine.decision.update({'max_epochs': 2})\n"
+        )
+        launcher = run_args(
+            [str(wf_py), str(cfg_py), "--random-seed", "1234"]
+        )
+        assert launcher.result is not None
+        assert launcher.result.epoch == 2  # config override respected
+
+    def test_stop_after_flag(self, tmp_path):
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        launcher = run_args(
+            [str(wf_py), "--random-seed", "1", "--stop-after", "1"]
+        )
+        assert launcher.result.epoch == 1
+
+    def test_dry_run(self, tmp_path):
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        launcher = run_args([str(wf_py), "--dry-run"])
+        assert launcher.result is None
+        assert launcher.workflow.state is not None
+
+    def test_snapshot_resume_via_cli(self, tmp_path):
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        run_args(
+            [
+                str(wf_py),
+                "--random-seed", "7",
+                "--stop-after", "2",
+                "--snapshot-dir", str(tmp_path / "snaps"),
+            ]
+        )
+        best = tmp_path / "snaps" / "WineWorkflow_best.pickle.gz"
+        assert best.exists()
+        launcher = run_args(
+            [
+                str(wf_py),
+                "--stop-after", "3",
+                "--snapshot", str(best),
+                "--snapshot-dir", str(tmp_path / "snaps2"),
+            ]
+        )
+        assert launcher.result.epoch == 3
+
+    def test_missing_run_convention_errors(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1\n")
+        with pytest.raises(SystemExit):
+            run_args([str(bad)])
+
+
+@pytest.fixture(autouse=True)
+def _isolate_workflow_modules():
+    yield
+    for name in ("__znicz_workflow__", "__znicz_config__"):
+        sys.modules.pop(name, None)

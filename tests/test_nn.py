@@ -1,0 +1,187 @@
+"""Tests for the nn layer: optimizer update rule, evaluators, decision."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.nn import decision, evaluator, lr_adjust, optimizer
+from znicz_tpu.nn.train_state import TrainState
+
+
+class TestOptimizer:
+    def test_plain_sgd_matches_manual(self):
+        w = jnp.array([1.0, -2.0])
+        g = jnp.array([0.5, 0.5])
+        v = jnp.zeros(2)
+        hyper = optimizer.HyperParams(learning_rate=0.1)
+        new_w, new_v = optimizer.update_param(w, g, v, "weights", hyper)
+        np.testing.assert_allclose(new_w, w - 0.1 * g, rtol=1e-6)
+        np.testing.assert_allclose(new_v, -0.1 * g, rtol=1e-6)
+
+    def test_momentum_accumulates(self):
+        # two steps with the same gradient: v2 = m*v1 - lr*g
+        hyper = optimizer.HyperParams(learning_rate=0.1, gradient_moment=0.9)
+        w = jnp.zeros(3)
+        g = jnp.ones(3)
+        v = jnp.zeros(3)
+        w, v = optimizer.update_param(w, g, v, "weights", hyper)
+        w2, v2 = optimizer.update_param(w, g, v, "weights", hyper)
+        np.testing.assert_allclose(v2, 0.9 * v - 0.1 * g, rtol=1e-6)
+        np.testing.assert_allclose(w2, w + v2, rtol=1e-6)
+
+    def test_l2_decay(self):
+        hyper = optimizer.HyperParams(learning_rate=1.0, weights_decay=0.1)
+        w = jnp.array([2.0])
+        new_w, _ = optimizer.update_param(w, jnp.zeros(1), jnp.zeros(1), "weights", hyper)
+        np.testing.assert_allclose(new_w, w - 0.1 * w, rtol=1e-6)
+
+    def test_l1_decay_sign(self):
+        hyper = optimizer.HyperParams(
+            learning_rate=1.0, weights_decay=0.1, l1_vs_l2=1.0
+        )
+        w = jnp.array([2.0, -3.0])
+        new_w, _ = optimizer.update_param(
+            w, jnp.zeros(2), jnp.zeros(2), "weights", hyper
+        )
+        np.testing.assert_allclose(new_w, w - 0.1 * jnp.sign(w), rtol=1e-6)
+
+    def test_bias_lr_multiplier(self):
+        hyper = optimizer.HyperParams(learning_rate=0.1, learning_rate_bias=0.2)
+        g = jnp.ones(2)
+        z = jnp.zeros(2)
+        new_w, _ = optimizer.update_param(z, g, z, "weights", hyper)
+        new_b, _ = optimizer.update_param(z, g, z, "bias", hyper)
+        np.testing.assert_allclose(new_b, 2.0 * new_w, rtol=1e-6)
+
+    def test_model_update_skips_empty_layers(self):
+        params = [{"weights": jnp.ones((2, 2))}, {}, {"bias": jnp.ones(2)}]
+        grads = [{"weights": jnp.ones((2, 2))}, {}, {"bias": jnp.ones(2)}]
+        vel = [{"weights": jnp.zeros((2, 2))}, {}, {"bias": jnp.zeros(2)}]
+        hyper = optimizer.HyperParams(learning_rate=0.5)
+        new_p, new_v = optimizer.update(params, grads, vel, hyper)
+        assert new_p[1] == {}
+        np.testing.assert_allclose(new_p[0]["weights"], 0.5 * np.ones((2, 2)))
+
+    def test_per_layer_hyper(self):
+        params = [{"weights": jnp.ones(1)}, {"weights": jnp.ones(1)}]
+        grads = [{"weights": jnp.ones(1)}, {"weights": jnp.ones(1)}]
+        vel = [{"weights": jnp.zeros(1)}, {"weights": jnp.zeros(1)}]
+        hyper = [
+            optimizer.HyperParams(learning_rate=0.1),
+            optimizer.HyperParams(learning_rate=0.3),
+        ]
+        new_p, _ = optimizer.update(params, grads, vel, hyper)
+        np.testing.assert_allclose(new_p[0]["weights"], [0.9], rtol=1e-6)
+        np.testing.assert_allclose(new_p[1]["weights"], [0.7], rtol=1e-6)
+
+    def test_clip_gradients(self):
+        grads = [{"weights": jnp.array([3.0, 4.0])}]
+        clipped = optimizer.clip_gradients(grads, 1.0)
+        np.testing.assert_allclose(
+            np.linalg.norm(clipped[0]["weights"]), 1.0, rtol=1e-5
+        )
+        assert optimizer.clip_gradients(grads, None) is grads
+
+
+class TestEvaluators:
+    def test_softmax_metrics(self):
+        logits = jnp.array([[10.0, 0.0], [0.0, 10.0], [10.0, 0.0]])
+        labels = jnp.array([0, 1, 1])  # third is wrong
+        m = evaluator.softmax(logits, labels)
+        assert int(m["n_err"]) == 1
+        assert float(m["loss"]) > 0
+        assert float(m["n_samples"]) == 3.0
+
+    def test_softmax_mask_excludes_padding(self):
+        logits = jnp.array([[10.0, 0.0], [10.0, 0.0]])
+        labels = jnp.array([0, 1])  # second wrong but masked out
+        m = evaluator.softmax(logits, labels, mask=jnp.array([1.0, 0.0]))
+        assert int(m["n_err"]) == 0
+        assert float(m["n_samples"]) == 1.0
+
+    def test_softmax_confusion(self):
+        logits = jnp.array([[5.0, 0.0], [0.0, 5.0], [5.0, 0.0]])
+        labels = jnp.array([0, 1, 1])
+        m = evaluator.softmax(logits, labels, compute_confusion=True)
+        conf = np.asarray(m["confusion"])
+        assert conf[0, 0] == 1 and conf[1, 1] == 1 and conf[1, 0] == 1
+
+    def test_mse_metrics(self):
+        out = jnp.array([[1.0, 1.0], [0.0, 0.0]])
+        tgt = jnp.array([[0.0, 0.0], [0.0, 0.0]])
+        m = evaluator.mse(out, tgt)
+        np.testing.assert_allclose(float(m["mse"]), 0.5, rtol=1e-6)
+        np.testing.assert_allclose(float(m["max_diff"]), 1.0, rtol=1e-6)
+        m2 = evaluator.mse(out, tgt, mask=jnp.array([0.0, 1.0]))
+        np.testing.assert_allclose(float(m2["mse"]), 0.0, atol=1e-7)
+
+
+class TestDecision:
+    def _epoch(self, d, n_err, split="valid"):
+        d.add_minibatch(split, {"n_samples": 100, "n_err": n_err, "loss": n_err / 100})
+        return d.on_epoch_end()
+
+    def test_improvement_and_stop_on_max_epochs(self):
+        d = decision.Decision(max_epochs=3, fail_iterations=100)
+        r1 = self._epoch(d, 50)
+        assert r1["improved"] and not r1["stop"]
+        r2 = self._epoch(d, 40)
+        assert r2["improved"] and not r2["stop"]
+        r3 = self._epoch(d, 45)
+        assert not r3["improved"] and r3["stop"]
+        assert d.best_value == 40 and d.best_epoch == 1
+
+    def test_stop_on_no_improvement(self):
+        d = decision.Decision(fail_iterations=2)
+        self._epoch(d, 10)
+        assert not self._epoch(d, 11)["stop"]
+        assert self._epoch(d, 12)["stop"]
+
+    def test_train_split_fallback(self):
+        d = decision.Decision(max_epochs=10)
+        r = self._epoch(d, 5, split="train")
+        assert r["improved"]
+
+    def test_state_roundtrip(self):
+        d = decision.Decision(max_epochs=10)
+        self._epoch(d, 7)
+        state = d.state_dict()
+        d2 = decision.Decision(max_epochs=10)
+        d2.load_state_dict(state)
+        assert d2.best_value == 7 and d2.epoch == 1
+
+
+class TestLrAdjust:
+    def test_policies(self):
+        assert lr_adjust.get("constant")(0.1, 100) == 0.1
+        np.testing.assert_allclose(
+            lr_adjust.get("step", step_size=10, gamma=0.5)(1.0, 25), 0.25
+        )
+        np.testing.assert_allclose(
+            lr_adjust.get("exp", gamma=0.9)(1.0, 2), 0.81
+        )
+        np.testing.assert_allclose(
+            lr_adjust.get("inv", gamma=1.0, power=1.0)(1.0, 3), 0.25
+        )
+        pol = lr_adjust.get("arbitrary", points=[(0, 1.0), (10, 0.1)])
+        assert pol(1.0, 5) == 1.0 and abs(pol(1.0, 15) - 0.1) < 1e-9
+        wc = lr_adjust.get("warmup_cosine", warmup=10, total=100)
+        assert wc(1.0, 0) < wc(1.0, 9) and wc(1.0, 99) < 0.01
+
+    def test_unknown_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            lr_adjust.get("nope")
+
+
+class TestTrainState:
+    def test_create(self):
+        import jax
+
+        params = [{"weights": jnp.ones((2, 2))}]
+        ts = TrainState.create(params, jax.random.key(0))
+        assert int(ts.step) == 0
+        np.testing.assert_allclose(ts.velocity[0]["weights"], 0.0)
+        # must be a pytree usable in jit
+        leaves = jax.tree_util.tree_leaves(ts)
+        assert len(leaves) >= 3

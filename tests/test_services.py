@@ -1,0 +1,81 @@
+"""Services tests: plotting, CSV metrics, image saver, status writer."""
+
+import json
+import os
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader import datasets
+from znicz_tpu.services import (
+    AccumulatingPlotter,
+    ImageSaver,
+    MetricsCSVWriter,
+    StatusWriter,
+    Weights2D,
+)
+from znicz_tpu.workflow import StandardWorkflow
+
+MLP_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 16}},
+    {"type": "softmax", "->": {"output_sample_shape": 10}},
+]
+
+
+def _wf(tmp_path, services, max_epochs=2):
+    loader = datasets.mnist(n_train=64, n_test=32, minibatch_size=32)
+    wf = StandardWorkflow(
+        loader,
+        MLP_LAYERS,
+        decision_config={"max_epochs": max_epochs},
+        default_hyper={"learning_rate": 0.05},
+    )
+    wf.services = services
+    wf.initialize(seed=4)
+    return wf
+
+
+def test_csv_and_plots_written(tmp_path):
+    prng.seed_all(4)
+    services = [
+        MetricsCSVWriter(str(tmp_path)),
+        AccumulatingPlotter(str(tmp_path), metric="loss"),
+        Weights2D(str(tmp_path), layer=0),
+    ]
+    wf = _wf(tmp_path, services)
+    wf.run()
+    assert (tmp_path / "metrics.csv").exists()
+    lines = (tmp_path / "metrics.csv").read_text().strip().splitlines()
+    assert len(lines) == 3  # header + 2 epochs
+    assert "train_loss" in lines[0]
+    assert (tmp_path / "loss.png").stat().st_size > 0
+    assert (tmp_path / "weights0.png").stat().st_size > 0
+
+
+def test_status_writer(tmp_path):
+    prng.seed_all(4)
+    wf = _wf(tmp_path, [StatusWriter(str(tmp_path))])
+    wf.run()
+    status = json.loads((tmp_path / "status.json").read_text())
+    assert status["epoch"] == 1
+    assert status["stopping"] is True
+    assert "train" in status["summary"]
+    assert "<table>" in (tmp_path / "status.html").read_text()
+
+
+def test_image_saver(tmp_path):
+    prng.seed_all(4)
+    wf = _wf(tmp_path, [ImageSaver(str(tmp_path), split="test", n_images=3)])
+    wf.run()
+    files = list((tmp_path / "epoch1").iterdir())
+    assert files, "no images saved"
+    assert all(f.suffix == ".png" for f in files)
+
+
+def test_service_failure_does_not_kill_training(tmp_path):
+    class Broken:
+        def on_epoch(self, wf, verdict):
+            raise RuntimeError("boom")
+
+    prng.seed_all(4)
+    wf = _wf(tmp_path, [Broken()])
+    dec = wf.run()  # must complete despite the failing service
+    assert dec.epoch == 2

@@ -1,0 +1,9 @@
+"""``python -m znicz_tpu`` — the reference's ``python3 -m veles`` entry point
+(SURVEY.md 3.1)."""
+
+import sys
+
+from znicz_tpu.launcher import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,25 @@ class RandomGenerator:
     def integers(self, low, high, shape=()) -> np.ndarray:
         return self._numpy.integers(low, high, shape)
 
+    # -- snapshot support (exact-resume contract, SURVEY.md 3.5) ----------
+    def state_dict(self) -> dict:
+        return {
+            "seed": self._seed,
+            "key": np.asarray(jax.random.key_data(self._key)),
+            "key_impl": str(jax.random.key_impl(self._key)),
+            "numpy_state": self._numpy.bit_generator.state,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._seed = state["seed"]
+        impl = state.get("key_impl")
+        self._key = jax.random.wrap_key_data(
+            jax.numpy.asarray(state["key"]),
+            **({"impl": impl} if impl else {}),
+        )
+        self._numpy = np.random.default_rng()
+        self._numpy.bit_generator.state = state["numpy_state"]
+
 
 _registry: Dict[str, RandomGenerator] = {}
 _global_seed: Optional[int] = None
@@ -97,6 +116,23 @@ def seed_all(seed: int) -> None:
     _global_seed = int(seed)
     for name, gen in _registry.items():
         gen.seed((seed ^ hash_name(name)) % (2**31))
+
+
+def state_dict() -> dict:
+    """Capture every named generator's stream position (for snapshots)."""
+    return {
+        "global_seed": _global_seed,
+        "generators": {n: g.state_dict() for n, g in _registry.items()},
+    }
+
+
+def load_state_dict(state: dict) -> None:
+    """Restore generator streams captured by :func:`state_dict`; resumed
+    runs draw the same shuffles/keys as the uninterrupted run."""
+    global _global_seed
+    _global_seed = state["global_seed"]
+    for name, gen_state in state["generators"].items():
+        get(name).load_state_dict(gen_state)
 
 
 def reset() -> None:

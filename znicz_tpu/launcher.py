@@ -1,0 +1,148 @@
+"""Launcher / CLI.
+
+Capability parity with ``veles/__main__.py`` + ``veles/launcher.py``
+[SURVEY.md 2.1 "Launcher / CLI", 3.1]: ``python -m znicz_tpu <workflow.py>
+[config.py] --flags`` loads the workflow module, applies the config module's
+``root`` overrides, then drives the module's ``run(load, main)`` convention —
+the same two-file UX the reference samples use.
+
+Flag mapping from the reference (SURVEY.md 5.6):
+  --device        device selection (tpu / cpu; reference: OpenCL/CUDA ordinal)
+  --random-seed   seeds the named PRNG registry
+  --snapshot      resume from a snapshot file
+  --snapshot-dir  where snapshots are written
+  --data-parallel shard the batch over all local devices (replaces
+                  --listen/--master-address: no master process exists,
+                  SURVEY.md 3.4)
+  --optimize      genetic hyperparameter search (veles --optimize)
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+from typing import Optional
+
+from znicz_tpu.core.config import root
+from znicz_tpu.core.logger import Logger, setup_logging
+
+
+def _load_module(path: str, name: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load module from {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m znicz_tpu",
+        description="TPU-native VELES/Znicz: run a workflow module",
+    )
+    p.add_argument("workflow", help="path to the workflow module (.py)")
+    p.add_argument(
+        "config", nargs="?", default=None,
+        help="optional config module mutating znicz_tpu.root",
+    )
+    p.add_argument("--device", default=None, choices=["tpu", "cpu"],
+                   help="force a jax platform (default: jax's choice)")
+    p.add_argument("--random-seed", type=int, default=None)
+    p.add_argument("--snapshot", default=None,
+                   help="resume training from this snapshot file")
+    p.add_argument("--snapshot-dir", default=None,
+                   help="write snapshots under this directory")
+    p.add_argument("--data-parallel", action="store_true",
+                   help="shard batches over all local devices")
+    p.add_argument("--stop-after", type=int, default=None, metavar="EPOCHS",
+                   help="override the workflow's max_epochs")
+    p.add_argument("--optimize", type=int, default=None, metavar="GENS",
+                   help="genetic hyperparameter search for N generations")
+    p.add_argument("--dry-run", action="store_true",
+                   help="build and initialize the workflow, run nothing")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+class Launcher(Logger):
+    """Owns CLI args; hands the workflow module its ``load``/``main`` pair."""
+
+    def __init__(self, args: argparse.Namespace):
+        self.args = args
+        self.workflow = None
+        self.result = None
+
+    # -- the module-facing convention (reference run(load, main)) ---------
+    def load(self, workflow_cls, *wf_args, **wf_kwargs):
+        """Construct the workflow, applying CLI overrides."""
+        if self.args.snapshot_dir and "snapshot_dir" not in wf_kwargs:
+            wf_kwargs["snapshot_dir"] = self.args.snapshot_dir
+        if self.args.stop_after is not None:
+            dc = dict(wf_kwargs.get("decision_config") or {})
+            dc["max_epochs"] = self.args.stop_after
+            wf_kwargs["decision_config"] = dc
+        if self.args.data_parallel and "parallel" not in wf_kwargs:
+            from znicz_tpu.parallel import DataParallel
+
+            wf_kwargs = dict(wf_kwargs)
+            self.workflow = workflow_cls(*wf_args, **wf_kwargs)
+            self.workflow.parallel = DataParallel()
+            return self.workflow
+        self.workflow = workflow_cls(*wf_args, **wf_kwargs)
+        return self.workflow
+
+    def main(self, **kwargs):
+        """Initialize and run the loaded workflow."""
+        if self.workflow is None:
+            raise RuntimeError("run(load, main): call load(...) before main()")
+        self.workflow.initialize(
+            seed=self.args.random_seed, snapshot=self.args.snapshot, **kwargs
+        )
+        if self.args.dry_run:
+            self.info("dry run: workflow initialized, skipping run()")
+            return None
+        self.result = self.workflow.run()
+        return self.result
+
+
+def run_args(argv=None) -> Launcher:
+    args = make_parser().parse_args(argv)
+    setup_logging(10 if args.verbose else 20)
+    if args.device:
+        # jax is imported by the package before CLI parsing and deployment
+        # sitecustomize hooks may force a platform config, so an explicit
+        # --device must go through jax.config (env vars are already ignored
+        # at this point).
+        import jax
+
+        jax.config.update(
+            "jax_platforms", "cpu" if args.device == "cpu" else None
+        )
+    launcher = Launcher(args)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(args.workflow)))
+    module = _load_module(args.workflow, "__znicz_workflow__")
+    if args.config:
+        _load_module(args.config, "__znicz_config__")
+    if not hasattr(module, "run"):
+        raise SystemExit(
+            f"{args.workflow} does not define run(load, main) "
+            "(reference workflow convention)"
+        )
+    if args.optimize:
+        from znicz_tpu.genetics import optimize_workflow
+
+        launcher.result = optimize_workflow(
+            module, launcher, generations=args.optimize
+        )
+        return launcher
+    module.run(launcher.load, launcher.main)
+    return launcher
+
+
+def main(argv=None) -> int:
+    run_args(argv)
+    return 0

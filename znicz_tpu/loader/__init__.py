@@ -1,0 +1,14 @@
+"""Data loaders: train/valid/test minibatch bookkeeping.
+
+Capability parity with ``veles/loader/`` (``Loader``, ``FullBatchLoader``) and
+``znicz/loader/`` [SURVEY.md 2.1 "Data loader base", 2.3 "Znicz loaders"].
+TPU-native contract: every minibatch has the SAME static shape (padded to
+``max_minibatch_size``) plus a validity ``mask`` — variable last batches are
+masked inside the jitted step instead of triggering recompilation
+(SURVEY.md §7 "Hard parts").
+"""
+
+from znicz_tpu.loader.base import TRAIN, VALID, TEST, Loader, Minibatch  # noqa: F401
+from znicz_tpu.loader.fullbatch import FullBatchLoader  # noqa: F401
+from znicz_tpu.loader import datasets  # noqa: F401
+from znicz_tpu.loader import normalizers  # noqa: F401

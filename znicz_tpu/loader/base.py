@@ -1,0 +1,133 @@
+"""Loader protocol: split bookkeeping, shuffling, static-shape minibatches.
+
+Reference semantics preserved (``veles/loader/base.py`` [SURVEY.md 2.1]):
+three splits (test/valid/train), per-split sample counts, train reshuffled
+every epoch from the shared named PRNG ("loader" generator), minibatch serving
+with an explicit end-of-epoch signal.  Reference semantics *changed*: the
+reference shrinks the last minibatch (``minibatch_size`` vs
+``max_minibatch_size``); here the batch shape is static and a float mask marks
+valid rows, because XLA recompiles on shape change.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from znicz_tpu.core import prng
+
+TRAIN, VALID, TEST = "train", "valid", "test"
+SPLITS = (TRAIN, VALID, TEST)
+
+
+class Minibatch(NamedTuple):
+    data: np.ndarray  # [max_minibatch_size, ...]  padded
+    labels: Optional[np.ndarray]  # [max_minibatch_size] int32, or None
+    targets: Optional[np.ndarray]  # regression/AE targets, or None
+    mask: np.ndarray  # [max_minibatch_size] float32, 1.0 = valid row
+    indices: np.ndarray  # dataset indices backing each row (padding repeats)
+
+
+class Loader:
+    """Abstract loader. Subclasses implement ``fill(indices, split)``.
+
+    ``class_lengths``: dict split -> number of samples (0 = split absent).
+    """
+
+    def __init__(
+        self,
+        *,
+        minibatch_size: int = 100,
+        shuffle: bool = True,
+        rand_name: str = "loader",
+    ):
+        self.max_minibatch_size = int(minibatch_size)
+        self.shuffle = shuffle
+        self.rand_name = rand_name
+        self._order: Dict[str, np.ndarray] = {}
+        self.epoch_number = 0
+
+    # -- subclass interface ------------------------------------------------
+    @property
+    def class_lengths(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+    @property
+    def sample_shape(self) -> tuple:
+        """Per-sample data shape (no batch dim) — drives model shape
+        inference in StandardWorkflow."""
+        raise NotImplementedError
+
+    def fill(self, indices: np.ndarray, split: str) -> Minibatch:
+        """Materialize the samples at ``indices`` of ``split``."""
+        raise NotImplementedError
+
+    # -- serving -----------------------------------------------------------
+    def n_minibatches(self, split: str) -> int:
+        n = self.class_lengths.get(split, 0)
+        return -(-n // self.max_minibatch_size) if n else 0
+
+    def _split_order(self, split: str) -> np.ndarray:
+        n = self.class_lengths[split]
+        order = self._order.get(split)
+        if order is None or len(order) != n:
+            order = np.arange(n)
+            self._order[split] = order
+        return order
+
+    def reshuffle(self, split: str = TRAIN) -> None:
+        n = self.class_lengths.get(split, 0)
+        if n:
+            self._order[split] = prng.get(self.rand_name).permutation(n)
+
+    def batches(self, split: str) -> Iterator[Minibatch]:
+        """Yield padded fixed-shape minibatches covering the split once."""
+        n = self.class_lengths.get(split, 0)
+        if not n:
+            return
+        if split == TRAIN and self.shuffle:
+            self.reshuffle(split)
+        order = self._split_order(split)
+        bs = self.max_minibatch_size
+        for start in range(0, n, bs):
+            idx = order[start : start + bs]
+            n_valid = len(idx)
+            if n_valid < bs:  # pad by repeating the first index; mask it out
+                pad = np.full(bs - n_valid, idx[0] if n_valid else 0)
+                idx = np.concatenate([idx, pad])
+            mb = self.fill(idx, split)
+            mask = np.zeros(bs, np.float32)
+            mask[:n_valid] = 1.0
+            yield mb._replace(mask=mask, indices=idx)
+
+    def epoch(self) -> Iterator[tuple]:
+        """One full epoch: train batches then valid then test, tagged."""
+        for split in (TRAIN, VALID, TEST):
+            for mb in self.batches(split):
+                yield split, mb
+        self.epoch_number += 1
+
+    # -- snapshot support ----------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "epoch_number": self.epoch_number,
+            "order": {k: v.copy() for k, v in self._order.items()},
+            # shuffle-stream position, so a resumed run draws the same
+            # permutations as the uninterrupted one (SURVEY.md 3.5)
+            "prng": prng.get(self.rand_name).state_dict(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch_number = state["epoch_number"]
+        self._order = {k: np.asarray(v) for k, v in state["order"].items()}
+        if "prng" in state:
+            prng.get(self.rand_name).load_state_dict(state["prng"])
+
+
+def split_sizes(n: int, fractions: Sequence[float]) -> Dict[str, int]:
+    """Partition ``n`` samples into train/valid/test by fractions
+    (train gets the remainder)."""
+    valid = int(n * fractions[0]) if len(fractions) > 0 else 0
+    test = int(n * fractions[1]) if len(fractions) > 1 else 0
+    return {TRAIN: n - valid - test, VALID: valid, TEST: test}

@@ -1,0 +1,227 @@
+"""Dataset constructors for the sample zoo.
+
+The reference ships per-sample loaders (MNIST IDX parsing under
+``znicz/samples/MNIST``, CIFAR pickle loader, UCI Wine, ImageNet pipeline)
+[SURVEY.md 2.3 "Znicz loaders", "Samples"].  This module reads the same
+standard on-disk formats when a data directory is supplied, and otherwise
+generates *deterministic synthetic stand-ins* with the same shapes/splits so
+every workflow and functional test runs hermetically (this machine has no
+network egress and no cached datasets).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+
+# ---------------------------------------------------------------------------
+# synthetic class-conditional generator (shared)
+# ---------------------------------------------------------------------------
+
+def _synthetic_classes(
+    n: int,
+    shape: Tuple[int, ...],
+    n_classes: int,
+    *,
+    rand_name: str = "datasets",
+    sep: float = 2.5,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Gaussian blobs around per-class prototype patterns — linearly hard,
+    MLP/conv easy, so seeded convergence tests behave like tiny MNIST."""
+    gen = prng.get(rand_name)
+    dim = int(np.prod(shape))
+    protos = gen.normal((n_classes, dim), 0.0, 1.0)
+    labels = gen.integers(0, n_classes, (n,)).astype(np.int32)
+    x = gen.normal((n, dim), 0.0, 1.0) + sep * protos[labels]
+    return x.reshape((n,) + shape).astype(np.float32), labels
+
+
+def _synthetic_split(
+    n_train: int,
+    n_test: int,
+    shape: Tuple[int, ...],
+    n_classes: int,
+    *,
+    test_split: str = "test",
+    sep: float = 2.5,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """One prototype draw shared by both splits (train and test must be the
+    SAME task), empty splits omitted."""
+    x, y = _synthetic_classes(n_train + n_test, shape, n_classes, sep=sep)
+    data, labels = {}, {}
+    if n_train:
+        data["train"], labels["train"] = x[:n_train], y[:n_train]
+    if n_test:
+        data[test_split], labels[test_split] = x[n_train:], y[n_train:]
+    return data, labels
+
+
+# ---------------------------------------------------------------------------
+# MNIST
+# ---------------------------------------------------------------------------
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = int.from_bytes(f.read(4), "big")
+        ndim = magic & 0xFF
+        dims = [int.from_bytes(f.read(4), "big") for _ in range(ndim)]
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def mnist(
+    data_dir: Optional[str] = None,
+    *,
+    minibatch_size: int = 100,
+    validation_ratio: float = 0.0,
+    flat: bool = True,
+    n_train: int = 2000,
+    n_test: int = 500,
+    **loader_kwargs,
+) -> FullBatchLoader:
+    """MNIST loader: real IDX files from ``data_dir`` if present, else
+    synthetic 28x28/10-class stand-in sized (n_train, n_test)."""
+    data: Dict[str, np.ndarray] = {}
+    labels: Dict[str, np.ndarray] = {}
+    if data_dir:
+        names = {
+            "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+            "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+        }
+        for split, (ims, labs) in names.items():
+            for suffix in ("", ".gz"):
+                ip = os.path.join(data_dir, ims + suffix)
+                lp = os.path.join(data_dir, labs + suffix)
+                if os.path.exists(ip) and os.path.exists(lp):
+                    data[split] = _read_idx(ip).astype(np.float32) / 255.0 - 0.5
+                    labels[split] = _read_idx(lp).astype(np.int32)
+                    break
+        if set(data) not in (set(), {"train", "test"}):
+            raise FileNotFoundError(
+                f"{data_dir} holds only the {sorted(data)} MNIST split(s); "
+                "need both train-* and t10k-* IDX files (or none, for the "
+                "synthetic stand-in)"
+            )
+    if not data:
+        data, labels = _synthetic_split(n_train, n_test, (28, 28), 10)
+    if validation_ratio > 0:
+        n = len(data["train"])
+        nv = int(n * validation_ratio)
+        data["valid"], labels["valid"] = data["train"][:nv], labels["train"][:nv]
+        data["train"], labels["train"] = data["train"][nv:], labels["train"][nv:]
+    if flat:
+        data = {k: v.reshape(len(v), -1) for k, v in data.items()}
+    else:
+        data = {k: v.reshape(len(v), 28, 28, 1) for k, v in data.items()}
+    return FullBatchLoader(
+        data, labels, minibatch_size=minibatch_size, **loader_kwargs
+    )
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10
+# ---------------------------------------------------------------------------
+
+def cifar10(
+    data_dir: Optional[str] = None,
+    *,
+    minibatch_size: int = 100,
+    n_train: int = 2000,
+    n_test: int = 500,
+    **loader_kwargs,
+) -> FullBatchLoader:
+    """CIFAR-10 NHWC loader: real python-pickle batches if present, else
+    synthetic 32x32x3/10-class stand-in."""
+    data: Dict[str, np.ndarray] = {}
+    labels: Dict[str, np.ndarray] = {}
+
+    def _load_batches(paths):
+        xs, ys = [], []
+        for p in paths:
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8))
+            ys.append(np.asarray(d[b"labels"], np.int32))
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x.astype(np.float32) / 255.0 - 0.5, np.concatenate(ys)
+
+    loaded = False
+    if data_dir:
+        batch_paths = [
+            os.path.join(data_dir, f"data_batch_{i}") for i in range(1, 6)
+        ]
+        test_path = os.path.join(data_dir, "test_batch")
+        if all(os.path.exists(p) for p in batch_paths + [test_path]):
+            data["train"], labels["train"] = _load_batches(batch_paths)
+            data["test"], labels["test"] = _load_batches([test_path])
+            loaded = True
+    if not loaded:
+        data, labels = _synthetic_split(n_train, n_test, (32, 32, 3), 10)
+    return FullBatchLoader(
+        data, labels, minibatch_size=minibatch_size, **loader_kwargs
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wine (UCI: 178 samples, 13 features, 3 classes)
+# ---------------------------------------------------------------------------
+
+def wine(
+    data_path: Optional[str] = None,
+    *,
+    minibatch_size: int = 10,
+    **loader_kwargs,
+) -> FullBatchLoader:
+    """UCI Wine: reads ``wine.data`` CSV if given, else a synthetic
+    178x13/3-class stand-in with the same proportions."""
+    if data_path and os.path.exists(data_path):
+        raw = np.loadtxt(data_path, delimiter=",")
+        labels_all = raw[:, 0].astype(np.int32) - 1
+        x_all = raw[:, 1:].astype(np.float32)
+    else:
+        x_all, labels_all = _synthetic_classes(178, (13,), 3, sep=3.0)
+    return FullBatchLoader(
+        {"train": x_all},
+        {"train": labels_all},
+        minibatch_size=minibatch_size,
+        normalization=loader_kwargs.pop("normalization", "mean_disp"),
+        **loader_kwargs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# ImageNet-class synthetic (for AlexNet workflow + bench)
+# ---------------------------------------------------------------------------
+
+def imagenet_synthetic(
+    *,
+    image_size: int = 227,
+    n_classes: int = 1000,
+    n_train: int = 512,
+    n_valid: int = 128,
+    minibatch_size: int = 128,
+    **loader_kwargs,
+) -> FullBatchLoader:
+    """Synthetic ImageNet-shaped data for the AlexNet workflow: the real
+    pipeline (resize/crop/mean-subtract, SURVEY.md 2.3) needs the dataset on
+    disk; shapes and class count here match so compiled programs are
+    identical."""
+    data, labels = _synthetic_split(
+        n_train,
+        n_valid,
+        (image_size, image_size, 3),
+        n_classes,
+        test_split="valid",
+        sep=1.0,
+    )
+    return FullBatchLoader(
+        data, labels, minibatch_size=minibatch_size, **loader_kwargs
+    )

@@ -1,0 +1,88 @@
+"""In-memory full-batch loader.
+
+Capability parity with ``veles/loader/fullbatch.py`` ``FullBatchLoader``
+[SURVEY.md 2.1]: the whole dataset lives in host arrays; minibatches are
+gathered by index.  Also covers the reference's targets path
+(``FullBatchLoaderMSE``-style: regression/autoencoder targets instead of int
+labels).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from znicz_tpu.loader import normalizers
+from znicz_tpu.loader.base import SPLITS, Loader, Minibatch
+
+
+class FullBatchLoader(Loader):
+    """Serve minibatches from per-split in-memory arrays.
+
+    ``data[split]``: [n, ...] float array; ``labels[split]``: [n] ints or
+    None; ``targets[split]``: same-shape-as-needed float array or None.
+    """
+
+    def __init__(
+        self,
+        data: Dict[str, np.ndarray],
+        labels: Optional[Dict[str, np.ndarray]] = None,
+        targets: Optional[Dict[str, np.ndarray]] = None,
+        *,
+        normalization: str = "none",
+        normalization_kwargs: Optional[dict] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.data = {k: np.asarray(v) for k, v in data.items() if v is not None}
+        self.labels = {
+            k: np.asarray(v, np.int32)
+            for k, v in (labels or {}).items()
+            if v is not None
+        }
+        self.targets = {
+            k: np.asarray(v) for k, v in (targets or {}).items() if v is not None
+        }
+        for split in self.data:
+            if split not in SPLITS:
+                raise ValueError(f"unknown split {split!r}")
+        train = self.data.get("train")
+        if train is None and normalization in ("linear", "mean_disp"):
+            raise ValueError(
+                f"normalization={normalization!r} must be fitted on a "
+                "'train' split, but this loader has none"
+            )
+        fit_src = train if train is not None else np.zeros((1, 1))
+        self.normalizer = normalizers.fit(
+            normalization,
+            fit_src.reshape(len(fit_src), -1),
+            **(normalization_kwargs or {}),
+        )
+        # Normalize each immutable split ONCE here, not per minibatch.
+        self.data = {
+            split: normalizers.apply(
+                self.normalizer, raw.reshape(len(raw), -1).astype(np.float32)
+            ).reshape(raw.shape)
+            for split, raw in self.data.items()
+        }
+
+    @property
+    def class_lengths(self) -> Dict[str, int]:
+        return {k: len(v) for k, v in self.data.items()}
+
+    @property
+    def sample_shape(self) -> tuple:
+        return next(iter(self.data.values())).shape[1:]
+
+    def fill(self, indices: np.ndarray, split: str) -> Minibatch:
+        data = self.data[split][indices]
+        labels = (
+            self.labels[split][indices] if split in self.labels else None
+        )
+        targets = (
+            self.targets[split][indices] if split in self.targets else None
+        )
+        return Minibatch(
+            data=data, labels=labels, targets=targets, mask=None, indices=indices
+        )

@@ -1,0 +1,54 @@
+"""Input normalizers.
+
+Capability parity with the reference loader's normalization modes
+(``veles/loader`` normalizers: linear range, mean-dispersion, external mean
+image for ImageNet) [SURVEY.md 2.1 "Data loader base"].  Each normalizer is
+``fit(data) -> state`` + ``apply(state, data)``; state is plain numpy so it
+pickles into snapshots.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def fit(kind: str, data: np.ndarray, **kwargs) -> Dict[str, object]:
+    """Compute normalizer state from training data."""
+    if kind == "none":
+        return {"kind": "none"}
+    if kind == "linear":  # scale to [-1, 1] per-feature
+        lo = data.min(axis=0)
+        hi = data.max(axis=0)
+        span = np.where(hi > lo, hi - lo, 1.0)
+        return {"kind": "linear", "lo": lo, "span": span}
+    if kind == "mean_disp":  # zero mean, unit dispersion per-feature
+        mean = data.mean(axis=0)
+        disp = data.std(axis=0)
+        return {"kind": "mean_disp", "mean": mean, "disp": np.where(disp > 0, disp, 1.0)}
+    if kind == "range":  # fixed affine x/scale + shift (e.g. /255 - 0.5)
+        return {
+            "kind": "range",
+            "scale": float(kwargs.get("scale", 255.0)),
+            "shift": float(kwargs.get("shift", 0.0)),
+        }
+    if kind == "external_mean":  # subtract a provided mean image (AlexNet)
+        return {"kind": "external_mean", "mean": np.asarray(kwargs["mean"])}
+    raise ValueError(f"unknown normalizer {kind!r}")
+
+
+def apply(state: Dict[str, object], data: np.ndarray) -> np.ndarray:
+    kind = state["kind"]
+    if kind == "none":
+        return data
+    data = data.astype(np.float32)
+    if kind == "linear":
+        return 2.0 * (data - state["lo"]) / state["span"] - 1.0
+    if kind == "mean_disp":
+        return (data - state["mean"]) / state["disp"]
+    if kind == "range":
+        return data / state["scale"] + state["shift"]
+    if kind == "external_mean":
+        return data - state["mean"]
+    raise ValueError(f"unknown normalizer {kind!r}")

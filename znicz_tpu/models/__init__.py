@@ -1,0 +1,39 @@
+"""Model zoo — parity with ``znicz/samples/`` [SURVEY.md 2.3 "Samples"].
+
+Each module follows the reference convention (SURVEY.md 3.1): it sets its
+defaults on the global ``root`` config tree at import, and exposes
+``run(load, main)`` which the launcher drives; a second config file may
+override ``root`` between import and run.  Every module also exposes
+``build_workflow(**overrides)`` for programmatic use (tests, benchmarks).
+"""
+
+
+def effective_config(node, defaults: dict):
+    """DEFAULTS merged under the user's ``root`` overrides.
+
+    Model modules call this inside ``build_workflow`` (not only at import) so
+    configs survive ``root`` being cleared/reset between runs — ``root``
+    carries only the *overrides*, mirroring the reference where defaults live
+    in the sample module and the config file mutates on top (SURVEY.md 5.6).
+    """
+    import copy
+
+    from znicz_tpu.core.config import Config
+
+    cfg = Config(getattr(node, "_config_path_", ""))
+    cfg.update(copy.deepcopy(defaults))
+    cfg.update(node.to_dict())
+    return cfg
+
+
+def merge_workflow_kwargs(base: dict, overrides: dict) -> dict:
+    """Merge CLI/caller overrides into a model's default workflow kwargs;
+    dict-valued keys (decision_config, snapshot_config) merge shallowly so a
+    ``--stop-after`` override doesn't clobber the model's other settings."""
+    out = dict(base)
+    for key, value in overrides.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = {**out[key], **value}
+        else:
+            out[key] = value
+    return out

@@ -1,0 +1,112 @@
+"""AlexNet-class ImageNet workflow — the MFU north-star model.
+
+Parity with ``znicz/samples/ImageNet/`` (AlexNet-class workflow,
+[SURVEY.md 2.3 "Samples"]; BASELINE.json north_star).  Canonical single-tower
+AlexNet geometry (227 input, 5 conv + 3 FC); bfloat16-friendly, NHWC, every
+conv/FC rides the MXU.  The real ImageNet pipeline needs the dataset on disk
+(``data_dir``); the synthetic stand-in keeps identical shapes so the compiled
+program — and therefore the benchmark — is the same.
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+_GD = {
+    "learning_rate": 0.01,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0005,
+    "learning_rate_bias": 0.02,
+    "weights_decay_bias": 0.0,
+}
+
+
+def _conv(n, k, *, sliding=(1, 1), padding=(0, 0, 0, 0)):
+    return {
+        "type": "conv_relu",
+        "->": {
+            "n_kernels": n, "kx": k, "ky": k, "sliding": sliding,
+            "padding": padding, "weights_filling": "gaussian",
+            "weights_stddev": 0.01,
+        },
+        "<-": _GD,
+    }
+
+
+DEFAULTS = {
+    "loader": {
+        "image_size": 227,
+        "n_classes": 1000,
+        "minibatch_size": 128,
+        "n_train": 512,  # synthetic stand-in sizes
+        "n_valid": 128,
+    },
+    "layers": [
+        _conv(96, 11, sliding=(4, 4)),
+        {"type": "norm", "->": {"n": 5, "alpha": 1e-4, "beta": 0.75, "k": 2.0}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        _conv(256, 5, padding=(2, 2, 2, 2)),
+        {"type": "norm", "->": {"n": 5, "alpha": 1e-4, "beta": 0.75, "k": 2.0}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        _conv(384, 3, padding=(1, 1, 1, 1)),
+        _conv(384, 3, padding=(1, 1, 1, 1)),
+        _conv(256, 3, padding=(1, 1, 1, 1)),
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {
+            "type": "all2all_relu",
+            "->": {
+                "output_sample_shape": 4096,
+                "weights_filling": "gaussian", "weights_stddev": 0.005,
+            },
+            "<-": _GD,
+        },
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {
+            "type": "all2all_relu",
+            "->": {
+                "output_sample_shape": 4096,
+                "weights_filling": "gaussian", "weights_stddev": 0.005,
+            },
+            "<-": _GD,
+        },
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {
+            "type": "softmax",
+            "->": {
+                "output_sample_shape": 1000,
+                "weights_filling": "gaussian", "weights_stddev": 0.01,
+            },
+            "<-": _GD,
+        },
+    ],
+    "decision": {"max_epochs": 90, "fail_iterations": 30},
+    "lr_policy": {"name": "step", "step_size": 100000, "gamma": 0.1},
+}
+root.alexnet.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.alexnet, DEFAULTS)
+    lcfg = cfg.loader
+    loader = datasets.imagenet_synthetic(
+        image_size=lcfg.get("image_size", 227),
+        n_classes=lcfg.get("n_classes", 1000),
+        n_train=lcfg.get("n_train", 512),
+        n_valid=lcfg.get("n_valid", 128),
+        minibatch_size=lcfg.get("minibatch_size", 128),
+    )
+    kwargs = merge_workflow_kwargs(
+        {
+            "decision_config": cfg.decision.to_dict(),
+            "lr_policy": cfg.get("lr_policy"),
+            "name": "AlexNetWorkflow",
+        },
+        overrides,
+    )
+    return StandardWorkflow(loader, cfg.get("layers"), **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

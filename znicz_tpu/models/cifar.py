@@ -1,0 +1,78 @@
+"""CIFAR-10 convolutional workflow.
+
+Parity with ``znicz/samples/CIFAR10/cifar.py`` [SURVEY.md 2.3 "Samples"]: a
+conv/pool/norm stack with a softmax head (BASELINE.json configs[1]).
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+_GD = {"learning_rate": 0.01, "gradient_moment": 0.9, "weights_decay": 0.0005}
+
+DEFAULTS = {
+    "loader": {
+        "data_dir": None,  # real cifar-10-batches-py dir; None -> synthetic
+        "minibatch_size": 100,
+        "n_train": 2000,
+        "n_test": 500,
+    },
+    "layers": [
+        {
+            "type": "conv_relu",
+            "->": {
+                "n_kernels": 32, "kx": 5, "ky": 5,
+                "padding": (2, 2, 2, 2), "weights_filling": "gaussian",
+                "weights_stddev": 0.01,
+            },
+            "<-": _GD,
+        },
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {"type": "norm", "->": {"n": 5}},
+        {
+            "type": "conv_relu",
+            "->": {
+                "n_kernels": 64, "kx": 5, "ky": 5,
+                "padding": (2, 2, 2, 2), "weights_filling": "gaussian",
+                "weights_stddev": 0.01,
+            },
+            "<-": _GD,
+        },
+        {"type": "avg_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+        {
+            "type": "all2all_relu",
+            "->": {"output_sample_shape": 64},
+            "<-": _GD,
+        },
+        {"type": "softmax", "->": {"output_sample_shape": 10}, "<-": _GD},
+    ],
+    "decision": {"max_epochs": 20, "fail_iterations": 20},
+    "lr_policy": {"name": "inv", "gamma": 0.0001, "power": 0.75},
+}
+root.cifar.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.cifar, DEFAULTS)
+    lcfg = cfg.loader
+    loader = datasets.cifar10(
+        lcfg.get("data_dir"),
+        minibatch_size=lcfg.get("minibatch_size", 100),
+        n_train=lcfg.get("n_train", 2000),
+        n_test=lcfg.get("n_test", 500),
+    )
+    kwargs = merge_workflow_kwargs(
+        {
+            "decision_config": cfg.decision.to_dict(),
+            "lr_policy": cfg.get("lr_policy"),
+            "name": "CifarWorkflow",
+        },
+        overrides,
+    )
+    return StandardWorkflow(loader, cfg.get("layers"), **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

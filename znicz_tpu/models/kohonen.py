@@ -1,0 +1,65 @@
+"""Kohonen self-organizing map demo.
+
+Parity with ``znicz/samples/DemoKohonen`` [SURVEY.md 2.3 "Samples";
+BASELINE.json configs[4]]: unsupervised SOM training on MNIST-shaped data.
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import KohonenWorkflow
+
+DEFAULTS = {
+    "loader": {
+        "data_dir": None,
+        "minibatch_size": 100,
+        "n_train": 1000,
+        "n_test": 200,
+    },
+    "sx": 8,
+    "sy": 8,
+    "total_epochs": 20,
+    "lr0": 0.5,
+    "lr1": 0.01,
+    "sigma1": 1.0,
+}
+root.kohonen.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> KohonenWorkflow:
+    cfg = effective_config(root.kohonen, DEFAULTS)
+    lcfg = cfg.loader
+    loader = datasets.mnist(
+        lcfg.get("data_dir"),
+        minibatch_size=lcfg.get("minibatch_size", 100),
+        n_train=lcfg.get("n_train", 1000),
+        n_test=lcfg.get("n_test", 200),
+        normalization="mean_disp",
+    )
+    kwargs = merge_workflow_kwargs(
+        {
+            "sx": cfg.get("sx", 8),
+            "sy": cfg.get("sy", 8),
+            "total_epochs": cfg.get("total_epochs", 20),
+            "lr0": cfg.get("lr0", 0.5),
+            "lr1": cfg.get("lr1", 0.01),
+            "sigma1": cfg.get("sigma1", 1.0),
+            "name": "KohonenWorkflow",
+        },
+        overrides,
+    )
+    # translate launcher-style overrides for the unsupervised workflow API
+    snapshot_dir = kwargs.pop("snapshot_dir", None)
+    if snapshot_dir:
+        from znicz_tpu.workflow import Snapshotter
+
+        kwargs["snapshotter"] = Snapshotter(snapshot_dir, kwargs["name"])
+    dc = kwargs.pop("decision_config", None)
+    if dc and "max_epochs" in dc:
+        kwargs["total_epochs"] = dc["max_epochs"]
+    return KohonenWorkflow(loader, **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

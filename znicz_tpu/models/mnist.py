@@ -1,0 +1,66 @@
+"""MNIST fully-connected MLP.
+
+Parity with ``znicz/samples/MNIST/mnist.py`` [SURVEY.md 2.3 "Samples"]: the
+classic 2-layer All2AllTanh(100) -> All2AllSoftmax(10) workflow with
+momentum-SGD and weight decay — the reference's PR1 acceptance config
+(BASELINE.json configs[0]).
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+DEFAULTS = {
+    "loader": {
+        "data_dir": None,  # real IDX dir; None -> deterministic synthetic
+        "minibatch_size": 100,
+        "validation_ratio": 0.15,
+        "n_train": 2000,  # synthetic stand-in sizes
+        "n_test": 500,
+    },
+    "layers": [
+        {
+            "type": "all2all_tanh",
+            "->": {"output_sample_shape": 100},
+            "<-": {
+                "learning_rate": 0.03,
+                "gradient_moment": 0.9,
+                "weights_decay": 0.0005,
+            },
+        },
+        {
+            "type": "softmax",
+            "->": {"output_sample_shape": 10},
+            "<-": {
+                "learning_rate": 0.03,
+                "gradient_moment": 0.9,
+                "weights_decay": 0.0005,
+            },
+        },
+    ],
+    "decision": {"max_epochs": 10, "fail_iterations": 20},
+}
+root.mnist.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.mnist, DEFAULTS)
+    lcfg = cfg.loader
+    loader = datasets.mnist(
+        lcfg.get("data_dir"),
+        minibatch_size=lcfg.get("minibatch_size", 100),
+        validation_ratio=lcfg.get("validation_ratio", 0.0),
+        n_train=lcfg.get("n_train", 2000),
+        n_test=lcfg.get("n_test", 500),
+    )
+    kwargs = merge_workflow_kwargs(
+        {"decision_config": cfg.decision.to_dict(), "name": "MnistWorkflow"},
+        overrides,
+    )
+    return StandardWorkflow(loader, cfg.get("layers"), **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

@@ -1,0 +1,70 @@
+"""MNIST convolutional autoencoder.
+
+Parity with ``znicz/samples/MNIST/mnist_ae.py`` [SURVEY.md 2.3 "Samples"]:
+conv encoder -> deconv decoder trained with MSE against the input
+(BASELINE.json configs[2] autoencoder path, exercising the
+Deconv/GDDeconv analogs of SURVEY.md 2.2).
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+_GD = {"learning_rate": 0.01, "gradient_moment": 0.9}
+
+DEFAULTS = {
+    "loader": {
+        "data_dir": None,
+        "minibatch_size": 100,
+        "n_train": 1000,
+        "n_test": 200,
+    },
+    "layers": [
+        {
+            "type": "conv_tanh",
+            "->": {
+                "n_kernels": 12, "kx": 10, "ky": 10, "sliding": (3, 3),
+                "weights_filling": "gaussian", "weights_stddev": 0.05,
+            },
+            "<-": _GD,
+        },
+        {
+            "type": "deconv",
+            "->": {
+                "n_channels": 1, "kx": 10, "ky": 10, "sliding": (3, 3),
+                "weights_filling": "gaussian", "weights_stddev": 0.05,
+            },
+            "<-": _GD,
+        },
+    ],
+    "decision": {"max_epochs": 20, "fail_iterations": 20},
+}
+root.mnist_ae.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.mnist_ae, DEFAULTS)
+    lcfg = cfg.loader
+    loader = datasets.mnist(
+        lcfg.get("data_dir"),
+        minibatch_size=lcfg.get("minibatch_size", 100),
+        n_train=lcfg.get("n_train", 1000),
+        n_test=lcfg.get("n_test", 200),
+        flat=False,  # conv layout NHWC
+    )
+    kwargs = merge_workflow_kwargs(
+        {
+            "decision_config": cfg.decision.to_dict(),
+            "loss_function": "mse",
+            "target": "input",
+            "name": "MnistAEWorkflow",
+        },
+        overrides,
+    )
+    return StandardWorkflow(loader, cfg.get("layers"), **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

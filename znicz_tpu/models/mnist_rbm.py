@@ -1,0 +1,64 @@
+"""MNIST Bernoulli RBM with CD-1.
+
+Parity with ``znicz/samples/MNIST`` RBM workflow (``mnist_rbm.py``)
+[SURVEY.md 2.3 "Samples"; BASELINE.json configs[2] RBM path].
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import RBMWorkflow
+
+DEFAULTS = {
+    "loader": {
+        "data_dir": None,
+        "minibatch_size": 100,
+        "n_train": 1000,
+        "n_test": 200,
+    },
+    "n_hidden": 128,
+    "learning_rate": 0.1,
+    "cd_k": 1,
+    "max_epochs": 20,
+}
+root.mnist_rbm.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> RBMWorkflow:
+    cfg = effective_config(root.mnist_rbm, DEFAULTS)
+    lcfg = cfg.loader
+    loader = datasets.mnist(
+        lcfg.get("data_dir"),
+        minibatch_size=lcfg.get("minibatch_size", 100),
+        n_train=lcfg.get("n_train", 1000),
+        n_test=lcfg.get("n_test", 200),
+        # Bernoulli units want [0,1] inputs: shift the synthetic/-0.5 data
+        normalization="linear",
+    )
+    # map [-1,1] -> [0,1]
+    for split, arr in loader.data.items():
+        loader.data[split] = (arr + 1.0) / 2.0
+    kwargs = merge_workflow_kwargs(
+        {
+            "n_hidden": cfg.get("n_hidden", 128),
+            "learning_rate": cfg.get("learning_rate", 0.1),
+            "cd_k": cfg.get("cd_k", 1),
+            "max_epochs": cfg.get("max_epochs", 20),
+            "name": "MnistRBMWorkflow",
+        },
+        overrides,
+    )
+    snapshot_dir = kwargs.pop("snapshot_dir", None)
+    if snapshot_dir:
+        from znicz_tpu.workflow import Snapshotter
+
+        kwargs["snapshotter"] = Snapshotter(snapshot_dir, kwargs["name"])
+    dc = kwargs.pop("decision_config", None)
+    if dc and "max_epochs" in dc:
+        kwargs["max_epochs"] = dc["max_epochs"]
+    return RBMWorkflow(loader, **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

@@ -1,0 +1,46 @@
+"""UCI Wine MLP — the reference's smallest end-to-end sample.
+
+Parity with ``znicz/samples/Wine`` [SURVEY.md 2.3 "Samples"]: a tiny
+All2AllTanh(10) -> softmax(3) net that trains to zero error in seconds.
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+DEFAULTS = {
+    "loader": {"data_path": None, "minibatch_size": 10},
+    "layers": [
+        {
+            "type": "all2all_tanh",
+            "->": {"output_sample_shape": 10},
+            "<-": {"learning_rate": 0.3, "gradient_moment": 0.5},
+        },
+        {
+            "type": "softmax",
+            "->": {"output_sample_shape": 3},
+            "<-": {"learning_rate": 0.3, "gradient_moment": 0.5},
+        },
+    ],
+    "decision": {"max_epochs": 100, "fail_iterations": 50},
+}
+root.wine.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.wine, DEFAULTS)
+    loader = datasets.wine(
+        cfg.loader.get("data_path"),
+        minibatch_size=cfg.loader.get("minibatch_size", 10),
+    )
+    kwargs = merge_workflow_kwargs(
+        {"decision_config": cfg.decision.to_dict(), "name": "WineWorkflow"},
+        overrides,
+    )
+    return StandardWorkflow(loader, cfg.get("layers"), **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

@@ -1,0 +1,16 @@
+"""NN training layer: the TPU-native equivalent of znicz's nn_units/gd plumbing.
+
+The reference pairs every forward unit with a hand-written ``GradientDescent*``
+unit carrying the update rule knobs (``learning_rate``, ``gradient_moment``,
+``weights_decay``, per-layer multipliers) [SURVEY.md 2.3 "NN unit bases"].
+Here the backward math is JAX autodiff and those knobs live in
+:mod:`znicz_tpu.nn.optimizer`; :mod:`znicz_tpu.nn.evaluator` mirrors
+``znicz/evaluator.py`` and :mod:`znicz_tpu.nn.decision` mirrors
+``znicz/decision.py``.
+"""
+
+from znicz_tpu.nn import decision  # noqa: F401
+from znicz_tpu.nn import evaluator  # noqa: F401
+from znicz_tpu.nn import lr_adjust  # noqa: F401
+from znicz_tpu.nn import optimizer  # noqa: F401
+from znicz_tpu.nn.train_state import TrainState  # noqa: F401

@@ -1,0 +1,141 @@
+"""Decision: epoch bookkeeping, best-model tracking, stop conditions.
+
+Capability parity with ``znicz/decision.py`` (``DecisionGD``, ``DecisionMSE``)
+[SURVEY.md 2.3 "Decision"]: accumulates per-split metrics across an epoch,
+tracks the best validation result, decides when training stops
+(``max_epochs`` reached, or ``fail_iterations`` epochs without validation
+improvement), and tells the workflow when to snapshot ("on improved
+validation", SURVEY.md 3.5/5.4).
+
+This is deliberately host-side Python (the reference's Decision was a
+gate-driven unit outside the hot kernels too); the jitted step only emits the
+per-minibatch metric scalars this class consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+TRAIN, VALID, TEST = "train", "valid", "test"
+
+
+class EpochMetrics:
+    """Accumulates additive metrics (n_err, loss*n, n_samples) over an epoch."""
+
+    def __init__(self):
+        self.n_samples = 0.0
+        self.n_err = 0.0
+        self.loss_sum = 0.0
+        self.extras: Dict[str, float] = {}
+
+    def add(self, metrics: Dict[str, float]) -> None:
+        n = float(metrics.get("n_samples", 0.0))
+        self.n_samples += n
+        self.n_err += float(metrics.get("n_err", 0.0))
+        self.loss_sum += float(metrics.get("loss", 0.0)) * n
+        for k, v in metrics.items():
+            if k in ("n_samples", "n_err", "loss"):
+                continue
+            try:
+                self.extras[k] = max(self.extras.get(k, float("-inf")), float(v))
+            except (TypeError, ValueError):
+                pass  # non-scalar extras (confusion matrix) are not reduced
+
+    @property
+    def loss(self) -> float:
+        return self.loss_sum / max(self.n_samples, 1.0)
+
+    @property
+    def err_pct(self) -> float:
+        return 100.0 * self.n_err / max(self.n_samples, 1.0)
+
+
+class Decision:
+    """Stopping/bookkeeping policy driven by epoch-end metric reports.
+
+    Usage per epoch: ``add_minibatch(split, metrics)`` for every step, then
+    ``on_epoch_end(epoch)`` once — it returns a dict with ``improved`` (bool:
+    validation got better; snapshot now) and ``stop`` (bool: training done).
+    When there is no validation split, the train split drives improvement.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_epochs: Optional[int] = None,
+        fail_iterations: int = 100,
+        metric: str = "n_err",  # "n_err" (classification) or "loss" (MSE)
+    ):
+        self.max_epochs = max_epochs
+        self.fail_iterations = fail_iterations
+        self.metric = metric
+        self.epoch = 0
+        self.best_value: Optional[float] = None
+        self.best_epoch = -1
+        self.epochs_since_best = 0
+        self.history: List[Dict[str, Dict[str, float]]] = []
+        self._current: Dict[str, EpochMetrics] = {}
+
+    def add_minibatch(self, split: str, metrics: Dict[str, float]) -> None:
+        self._current.setdefault(split, EpochMetrics()).add(metrics)
+
+    def _epoch_value(self) -> Optional[float]:
+        src = self._current.get(VALID) or self._current.get(TRAIN)
+        if src is None:
+            return None
+        return src.n_err if self.metric == "n_err" else src.loss
+
+    def on_epoch_end(self, epoch: Optional[int] = None) -> Dict[str, object]:
+        if epoch is not None:
+            self.epoch = epoch
+        summary = {
+            split: {
+                "n_samples": m.n_samples,
+                "n_err": m.n_err,
+                "err_pct": m.err_pct,
+                "loss": m.loss,
+                **m.extras,
+            }
+            for split, m in self._current.items()
+        }
+        self.history.append(summary)
+        value = self._epoch_value()
+        improved = False
+        if value is not None and (
+            self.best_value is None or value < self.best_value
+        ):
+            self.best_value = value
+            self.best_epoch = self.epoch
+            self.epochs_since_best = 0
+            improved = True
+        else:
+            self.epochs_since_best += 1
+        stop = (
+            self.max_epochs is not None and self.epoch + 1 >= self.max_epochs
+        ) or (self.epochs_since_best >= self.fail_iterations)
+        self._current = {}
+        self.epoch += 1
+        return {
+            "improved": improved,
+            "stop": stop,
+            "summary": summary,
+            "best_value": self.best_value,
+            "best_epoch": self.best_epoch,
+        }
+
+    # -- checkpointable state (host side of snapshot/resume, SURVEY.md 3.5) --
+    def state_dict(self) -> Dict[str, object]:
+        return {
+            "epoch": self.epoch,
+            "best_value": self.best_value,
+            "best_epoch": self.best_epoch,
+            "epochs_since_best": self.epochs_since_best,
+            "history": self.history,
+        }
+
+    def load_state_dict(self, state: Dict[str, object]) -> None:
+        self.epoch = state["epoch"]
+        self.best_value = state["best_value"]
+        self.best_epoch = state["best_epoch"]
+        self.epochs_since_best = state["epochs_since_best"]
+        self.history = list(state["history"])

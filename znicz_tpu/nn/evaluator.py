@@ -1,0 +1,95 @@
+"""Evaluators: outputs + labels -> loss and metrics.
+
+Capability parity with ``znicz/evaluator.py`` (``EvaluatorSoftmax``:
+cross-entropy, n_err, confusion matrix, max_err_output_sum; ``EvaluatorMSE``)
+[SURVEY.md 2.3 "Evaluators"].  In the reference the evaluator *emits
+err_output* to seed the hand-written backward chain; here the loss scalar is
+the autodiff seed, so each evaluator is a pure loss + metrics function used
+inside the jitted step.
+
+All functions take a ``mask`` (float [batch]) so the variable-size last
+minibatch of an epoch is handled by masking inside jit instead of re-compiling
+for a smaller batch (SURVEY.md §7 "Hard parts").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops.all2all import log_softmax
+
+
+def _norm_mask(mask: Optional[jnp.ndarray], batch: int):
+    if mask is None:
+        return jnp.ones((batch,), jnp.float32), float(batch)
+    mask = mask.astype(jnp.float32)
+    return mask, jnp.maximum(mask.sum(), 1.0)
+
+
+def softmax(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+    n_classes: Optional[int] = None,
+    compute_confusion: bool = False,
+) -> Dict[str, jnp.ndarray]:
+    """Cross-entropy over integer labels.
+
+    Returns ``loss`` (mean CE over valid samples), ``n_err`` (int count of
+    misclassifications — the reference's headline metric), ``max_err_y_sum``
+    (largest |p - onehot| mass, the reference's saturation probe), and
+    optionally ``confusion`` [n_classes, n_classes] (rows = truth).
+    """
+    mask, n_valid = _norm_mask(mask, logits.shape[0])
+    logp = log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    loss = jnp.sum(nll * mask) / n_valid
+    pred = jnp.argmax(logits, axis=1)
+    err = (pred != labels).astype(jnp.float32) * mask
+    out = {
+        "loss": loss,
+        "n_err": jnp.sum(err).astype(jnp.int32),
+        "n_samples": n_valid,
+    }
+    p = jnp.exp(logp)
+    onehot = jnp.zeros_like(p).at[jnp.arange(p.shape[0]), labels].set(1.0)
+    out["max_err_y_sum"] = jnp.max(
+        jnp.sum(jnp.abs(p - onehot), axis=1) * mask
+    )
+    if compute_confusion:
+        nc = n_classes or logits.shape[-1]
+        flat = labels * nc + pred
+        out["confusion"] = jnp.zeros((nc * nc,), jnp.int32).at[flat].add(
+            mask.astype(jnp.int32)
+        ).reshape(nc, nc)
+    return out
+
+
+def mse(
+    output: jnp.ndarray,
+    target: jnp.ndarray,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+    root: bool = True,
+) -> Dict[str, jnp.ndarray]:
+    """Mean-squared-error evaluator (EvaluatorMSE).
+
+    Returns ``loss`` (mean over valid samples of per-sample mean square),
+    ``mse`` (same), ``max_diff``, and ``rmse`` when ``root``.
+    """
+    mask, n_valid = _norm_mask(mask, output.shape[0])
+    diff = (output - target).reshape(output.shape[0], -1)
+    per_sample = jnp.mean(jnp.square(diff), axis=1)
+    loss = jnp.sum(per_sample * mask) / n_valid
+    out = {
+        "loss": loss,
+        "mse": loss,
+        "max_diff": jnp.max(jnp.max(jnp.abs(diff), axis=1) * mask),
+        "n_samples": n_valid,
+    }
+    if root:
+        out["rmse"] = jnp.sqrt(loss)
+    return out

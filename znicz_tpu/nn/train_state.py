@@ -1,0 +1,38 @@
+"""Pure-pytree train state.
+
+The reference snapshots the entire mutable workflow object graph
+(``veles/snapshotter.py``, SURVEY.md 3.5) — here the checkpointable training
+state is an explicit immutable pytree, which is what makes jit/pjit, donation
+and Orbax-style checkpointing work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TrainState(NamedTuple):
+    """Everything the jitted train step reads and writes.
+
+    params / velocity are matching pytrees (velocity is the momentum buffer,
+    the reference's per-unit accumulated gradient with ``gradient_moment``).
+    ``key`` seeds in-step randomness (dropout, stochastic pooling).
+    """
+
+    params: Any
+    velocity: Any
+    step: jnp.ndarray  # int32 scalar
+    key: jax.Array
+
+    @classmethod
+    def create(cls, params, key) -> "TrainState":
+        velocity = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return cls(
+            params=params,
+            velocity=velocity,
+            step=jnp.zeros((), jnp.int32),
+            key=key,
+        )

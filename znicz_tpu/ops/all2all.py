@@ -20,6 +20,7 @@ import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.ops import activation as act
+from znicz_tpu.ops.filling import fill
 
 
 def init_params(
@@ -42,23 +43,8 @@ def init_params(
         weights_stddev = 1.0 / np.sqrt(n_input)
     if bias_stddev is None:
         bias_stddev = weights_stddev
-    shape = (n_input, n_output)
-    if weights_filling == "uniform":
-        w = gen.uniform(shape, -weights_stddev, weights_stddev)
-    elif weights_filling == "gaussian":
-        w = gen.normal(shape, 0.0, weights_stddev)
-    elif weights_filling == "constant":
-        w = np.full(shape, weights_stddev, np.float32)
-    else:
-        raise ValueError(f"unknown weights_filling {weights_filling!r}")
-    if bias_filling == "uniform":
-        b = gen.uniform((n_output,), -bias_stddev, bias_stddev)
-    elif bias_filling == "gaussian":
-        b = gen.normal((n_output,), 0.0, bias_stddev)
-    elif bias_filling == "constant":
-        b = np.full((n_output,), bias_stddev, np.float32)
-    else:
-        raise ValueError(f"unknown bias_filling {bias_filling!r}")
+    w = fill(gen, (n_input, n_output), weights_filling, weights_stddev)
+    b = fill(gen, (n_output,), bias_filling, bias_stddev)
     return {"weights": jnp.asarray(w, dtype), "bias": jnp.asarray(b, dtype)}
 
 

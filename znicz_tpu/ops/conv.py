@@ -21,6 +21,7 @@ import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.ops import activation as act
+from znicz_tpu.ops.filling import fill
 
 DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
 
@@ -34,6 +35,7 @@ def init_params(
     weights_stddev: Optional[float] = None,
     bias_stddev: Optional[float] = None,
     weights_filling: str = "uniform",
+    bias_filling: str = "uniform",
     rand_name: str = "default",
     dtype=jnp.float32,
 ) -> Dict[str, jnp.ndarray]:
@@ -43,14 +45,8 @@ def init_params(
         weights_stddev = 1.0 / np.sqrt(fan_in)
     if bias_stddev is None:
         bias_stddev = weights_stddev
-    shape = (ky, kx, n_channels, n_kernels)
-    if weights_filling == "uniform":
-        w = gen.uniform(shape, -weights_stddev, weights_stddev)
-    elif weights_filling == "gaussian":
-        w = gen.normal(shape, 0.0, weights_stddev)
-    else:
-        raise ValueError(f"unknown weights_filling {weights_filling!r}")
-    b = gen.uniform((n_kernels,), -bias_stddev, bias_stddev)
+    w = fill(gen, (ky, kx, n_channels, n_kernels), weights_filling, weights_stddev)
+    b = fill(gen, (n_kernels,), bias_filling, bias_stddev)
     return {"weights": jnp.asarray(w, dtype), "bias": jnp.asarray(b, dtype)}
 
 

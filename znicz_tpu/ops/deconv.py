@@ -44,16 +44,12 @@ def init_params(
     from znicz_tpu.core import prng
     import numpy as np
 
+    from znicz_tpu.ops.filling import fill
+
     gen = prng.get(rand_name)
     if weights_stddev is None:
         weights_stddev = 1.0 / np.sqrt(kx * ky * n_kernels)
-    shape = (ky, kx, n_channels, n_kernels)
-    if weights_filling == "uniform":
-        w = gen.uniform(shape, -weights_stddev, weights_stddev)
-    elif weights_filling == "gaussian":
-        w = gen.normal(shape, 0.0, weights_stddev)
-    else:
-        raise ValueError(f"unknown weights_filling {weights_filling!r}")
+    w = fill(gen, (ky, kx, n_channels, n_kernels), weights_filling, weights_stddev)
     return {"weights": jnp.asarray(w, dtype)}
 
 

@@ -1,0 +1,31 @@
+"""Shared weight/bias filling.
+
+One implementation of the reference's ``weights_filling``/``bias_filling``
+modes (uniform / gaussian / constant, ``veles`` nn_units weight init
+[SURVEY.md 2.3 "NN unit bases"]) used by every parameterized op, so the
+supported modes cannot drift between layers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.core.prng import RandomGenerator
+
+FILLINGS = ("uniform", "gaussian", "constant")
+
+
+def fill(
+    gen: RandomGenerator, shape, filling: str, stddev: float
+) -> np.ndarray:
+    """Draw one parameter tensor; exactly one generator draw for the random
+    modes so deterministic PRNG streams stay aligned across configs."""
+    if filling == "uniform":
+        return gen.uniform(shape, -stddev, stddev)
+    if filling == "gaussian":
+        return gen.normal(shape, 0.0, stddev)
+    if filling == "constant":
+        return np.full(shape, stddev, np.float32)
+    raise ValueError(
+        f"unknown filling {filling!r}; expected one of {FILLINGS}"
+    )

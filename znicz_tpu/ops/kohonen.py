@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu.core import prng
+from znicz_tpu.ops.filling import fill
 
 
 def init_params(
@@ -27,13 +28,14 @@ def init_params(
     n_input: int,
     *,
     weights_stddev: float | None = None,
+    weights_filling: str = "uniform",
     rand_name: str = "default",
     dtype=jnp.float32,
 ) -> Dict[str, jnp.ndarray]:
     gen = prng.get(rand_name)
     if weights_stddev is None:
         weights_stddev = 1.0 / np.sqrt(n_input)
-    w = gen.uniform((sx * sy, n_input), -weights_stddev, weights_stddev)
+    w = fill(gen, (sx * sy, n_input), weights_filling, weights_stddev)
     return {"weights": jnp.asarray(w, dtype)}
 
 
@@ -58,6 +60,7 @@ def train_step(
     *,
     learning_rate: jnp.ndarray,
     sigma: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
     """One batch-SOM update; returns (new_params, winner indices).
 
@@ -77,6 +80,8 @@ def train_step(
         jnp.square(coords[None, :, :] - coords[win][:, None, :]), axis=-1
     )  # [B, M]
     h = jnp.exp(-d2 / (2.0 * jnp.square(sigma)))  # [B, M]
+    if mask is not None:  # padded rows of a static batch get zero weight
+        h = h * mask[:, None]
     num = h.T @ x  # [M, F]
     denom = jnp.sum(h, axis=0)[:, None]  # [M, 1]
     target = num / jnp.maximum(denom, 1e-12)

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu.core import prng
+from znicz_tpu.ops.filling import fill
 
 
 def init_params(
@@ -22,6 +23,7 @@ def init_params(
     n_hidden: int,
     *,
     weights_stddev: float | None = None,
+    weights_filling: str = "gaussian",
     rand_name: str = "default",
     dtype=jnp.float32,
 ) -> Dict[str, jnp.ndarray]:
@@ -30,7 +32,8 @@ def init_params(
         weights_stddev = 1.0 / np.sqrt(n_visible)
     return {
         "weights": jnp.asarray(
-            gen.normal((n_visible, n_hidden), 0.0, weights_stddev), dtype
+            fill(gen, (n_visible, n_hidden), weights_filling, weights_stddev),
+            dtype,
         ),
         "vbias": jnp.zeros((n_visible,), dtype),
         "hbias": jnp.zeros((n_hidden,), dtype),
@@ -56,9 +59,15 @@ def cd_step(
     *,
     learning_rate: float,
     cd_k: int = 1,
+    mask: jnp.ndarray | None = None,
 ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
-    """One CD-k update; returns (new_params, reconstruction error scalar)."""
-    batch = v0.shape[0]
+    """One CD-k update; returns (new_params, reconstruction error scalar).
+
+    ``mask`` ([B] float) zero-weights padded rows of a static batch.
+    """
+    if mask is None:
+        mask = jnp.ones((v0.shape[0],), v0.dtype)
+    n_valid = jnp.maximum(jnp.sum(mask), 1.0)
     h0_probs = hidden_probs(params, v0)
 
     def gibbs(carry, key):
@@ -74,11 +83,16 @@ def cd_step(
     _, (v_chain, h_chain) = jax.lax.scan(gibbs, h0_sample, jnp.stack(keys))
     vk_probs, hk_probs = v_chain[-1], h_chain[-1]
 
-    lr = learning_rate / batch
+    lr = learning_rate / n_valid
+    m = mask[:, None]
     new = {
-        "weights": params["weights"] + lr * (v0.T @ h0_probs - vk_probs.T @ hk_probs),
-        "vbias": params["vbias"] + lr * jnp.sum(v0 - vk_probs, axis=0),
-        "hbias": params["hbias"] + lr * jnp.sum(h0_probs - hk_probs, axis=0),
+        "weights": params["weights"]
+        + lr * ((v0 * m).T @ h0_probs - (vk_probs * m).T @ hk_probs),
+        "vbias": params["vbias"] + lr * jnp.sum((v0 - vk_probs) * m, axis=0),
+        "hbias": params["hbias"]
+        + lr * jnp.sum((h0_probs - hk_probs) * m, axis=0),
     }
-    recon_err = jnp.mean(jnp.square(v0 - vk_probs))
+    recon_err = jnp.sum(
+        jnp.mean(jnp.square(v0 - vk_probs), axis=1) * mask
+    ) / n_valid
     return new, recon_err

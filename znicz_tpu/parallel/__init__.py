@@ -1,0 +1,25 @@
+"""Distribution layer: SPMD sharding over a device mesh.
+
+Replaces the reference's entire L3 master-slave stack (``veles/server.py``,
+``veles/client.py``, ZeroMQ+Twisted transport, ``IDistributable`` gradient
+shipping — SURVEY.md 2.1, 2.5, 3.4): the batch is sharded over the mesh's
+``data`` axis, parameters are replicated (or sharded over ``model`` for
+tensor parallelism), and XLA emits the gradient all-reduce over ICI inside
+the one jitted train step.  ``generate_data_for_slave`` / |
+``apply_data_from_slave`` have no API equivalent — their observable behavior
+(every device trains on its shard, one consistent model) is delivered by
+construction, synchronously.
+
+Elasticity contract (SURVEY.md 5.3): the reference's drop-slave/rejoin has no
+SPMD equivalent; failure recovery is checkpoint-based restart via the
+snapshotter.
+"""
+
+from znicz_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    replicated,
+)
+from znicz_tpu.parallel.data_parallel import DataParallel  # noqa: F401

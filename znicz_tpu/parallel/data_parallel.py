@@ -1,0 +1,96 @@
+"""Data (+ optional tensor) parallel placement policy for workflows.
+
+The TPU-native replacement for the reference's asynchronous parameter-server
+DP (SURVEY.md 2.5 row "Data parallel"): the jitted train step runs SPMD over
+the mesh; XLA turns the gradient contraction into an all-reduce over ICI.
+Synchronous by construction — the convergence-relevant behavior
+(every sample contributes once per epoch, one consistent model) matches the
+reference's centralized aggregation.
+
+Tensor parallelism (absent in the reference, SURVEY.md 2.5): FC/conv weights
+whose output dim is divisible by the ``model`` axis and larger than
+``tp_min_features`` are sharded on that dim; GSPMD propagates activations'
+shardings and inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from znicz_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    replicated,
+)
+
+
+class DataParallel:
+    """Placement policy: how batches and params land on the mesh.
+
+    ``tp``: enable tensor-parallel weight sharding over the ``model`` axis.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        *,
+        tp: bool = False,
+        tp_min_features: int = 1024,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.tp = tp and self.mesh.shape[MODEL_AXIS] > 1
+        self.tp_min_features = tp_min_features
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    # -- batches -----------------------------------------------------------
+    def shard_batch(self, arr) -> jax.Array:
+        """Place a host batch sharded over the data axis (batch dim 0 must
+        divide by the axis size; the loader's padded static batches ensure a
+        constant batch size, so pick minibatch_size accordingly)."""
+        arr = np.asarray(arr)
+        if arr.shape[0] % self.n_data:
+            raise ValueError(
+                f"batch {arr.shape[0]} not divisible by data axis "
+                f"{self.n_data}; choose minibatch_size as a multiple"
+            )
+        return jax.device_put(arr, data_sharding(self.mesh, arr.ndim))
+
+    # -- params ------------------------------------------------------------
+    def _param_spec(self, path: str, leaf) -> P:
+        if (
+            self.tp
+            and hasattr(leaf, "ndim")
+            and leaf.ndim >= 1
+            and leaf.shape[-1] >= self.tp_min_features
+            and leaf.shape[-1] % self.mesh.shape[MODEL_AXIS] == 0
+        ):
+            # shard the output-features dim: column-parallel FC / conv
+            return P(*([None] * (leaf.ndim - 1)), MODEL_AXIS)
+        return P()
+
+    def shard_state(self, state):
+        """Place a TrainState: params/velocity per policy, scalars/key
+        replicated."""
+
+        def place(path, leaf):
+            spec = self._param_spec(jax.tree_util.keystr(path), leaf)
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        params = jax.tree_util.tree_map_with_path(place, state.params)
+        velocity = jax.tree_util.tree_map_with_path(place, state.velocity)
+        rep = replicated(self.mesh)
+        return state._replace(
+            params=params,
+            velocity=velocity,
+            step=jax.device_put(state.step, rep),
+            key=jax.device_put(state.key, rep),
+        )

@@ -1,0 +1,55 @@
+"""Mesh construction + sharding helpers.
+
+The mesh replaces the reference's master/slave process topology (SURVEY.md
+3.4): axis ``data`` shards the batch (the reference's one parallelism
+strategy, SURVEY.md 2.5), axis ``model`` optionally shards large layer
+outputs (tensor parallelism — a new capability the reference lacks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, model) mesh over the given (default: all) devices.
+
+    ``n_data=None`` uses every remaining device on the data axis.  On real
+    hardware callers should order devices so the model axis rides the
+    fastest ICI links; here we take jax's default device order.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_model
+    if n_data * n_model > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.array(devices[: n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard dim 0 (batch) over ``data``; everything else replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_divisible(batch: int, mesh: Mesh) -> bool:
+    return batch % mesh.shape[DATA_AXIS] == 0

@@ -1,0 +1,79 @@
+"""Status service: live training-state snapshot as JSON + HTML.
+
+Parity with ``veles/web_status.py`` [SURVEY.md 2.1 "Web status"]: the
+reference runs a tornado dashboard showing master/slaves/workflow progress.
+Here the per-epoch state is written as ``status.json`` + a static
+``status.html`` that auto-refreshes — servable by anything (``python -m
+http.server``), with no long-running service process coupled to training.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import time
+
+
+class StatusWriter:
+    def __init__(self, directory: str, *, refresh_seconds: int = 5):
+        self.directory = directory
+        self.refresh_seconds = refresh_seconds
+        self._t0 = time.time()
+        os.makedirs(directory, exist_ok=True)
+
+    def on_epoch(self, workflow, verdict) -> None:
+        dec = workflow.decision
+        status = {
+            "workflow": workflow.name,
+            "epoch": dec.epoch - 1,
+            "max_epochs": dec.max_epochs,
+            "best_value": dec.best_value,
+            "best_epoch": dec.best_epoch,
+            "improved": bool(verdict["improved"]),
+            "stopping": bool(verdict["stop"]),
+            "elapsed_seconds": round(time.time() - self._t0, 1),
+            "devices": self._devices(),
+            "summary": verdict["summary"],
+            "history_len": len(dec.history),
+        }
+        with open(os.path.join(self.directory, "status.json"), "w") as f:
+            json.dump(status, f, indent=2)
+        self._write_html(status)
+
+    @staticmethod
+    def _devices():
+        try:
+            import jax
+
+            return [str(d) for d in jax.devices()]
+        except Exception:  # status must never break training
+            return []
+
+    def _write_html(self, status) -> None:
+        rows = []
+        for split, m in status["summary"].items():
+            cells = "".join(
+                f"<td>{html.escape(f'{v:.4f}' if isinstance(v, float) else str(v))}</td>"
+                for v in (
+                    m.get("n_samples", ""),
+                    m.get("loss", ""),
+                    m.get("err_pct", ""),
+                )
+            )
+            rows.append(f"<tr><td>{html.escape(split)}</td>{cells}</tr>")
+        doc = f"""<!DOCTYPE html>
+<html><head><meta http-equiv="refresh" content="{self.refresh_seconds}">
+<title>{html.escape(status['workflow'])}</title>
+<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #999;padding:4px 10px}}</style></head><body>
+<h2>{html.escape(status['workflow'])}</h2>
+<p>epoch {status['epoch']} / {status['max_epochs']} —
+best {status['best_value']} @ {status['best_epoch']} —
+{status['elapsed_seconds']}s elapsed</p>
+<p>devices: {html.escape(', '.join(status['devices']))}</p>
+<table><tr><th>split</th><th>n</th><th>loss</th><th>err%</th></tr>
+{''.join(rows)}</table>
+</body></html>"""
+        with open(os.path.join(self.directory, "status.html"), "w") as f:
+            f.write(doc)

@@ -1,0 +1,18 @@
+"""Workflow engine: the TPU-native re-founding of veles's Unit/Workflow DAG.
+
+The reference executes an imperative, event-driven DAG of mutable units on a
+thread pool (``veles/workflow.py``, SURVEY.md 1 L4, 3.1).  Here a workflow is
+an out-of-jit control region (loader, decision, snapshotter — the parts that
+were gate-driven Python anyway) around ONE jit-compiled train step (forwards +
+loss + grads + update + metric scalars) — the hot loop of SURVEY.md 3.1
+compiled as a single XLA program [SURVEY.md §7 "Design stance"].
+"""
+
+from znicz_tpu.workflow.model import Model, build  # noqa: F401
+from znicz_tpu.workflow.snapshotter import Snapshotter  # noqa: F401
+from znicz_tpu.workflow.workflow import Workflow  # noqa: F401
+from znicz_tpu.workflow.standard import StandardWorkflow  # noqa: F401
+from znicz_tpu.workflow.unsupervised import (  # noqa: F401
+    KohonenWorkflow,
+    RBMWorkflow,
+)

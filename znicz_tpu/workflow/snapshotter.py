@@ -1,0 +1,152 @@
+"""Checkpoint / resume.
+
+Capability parity with ``veles/snapshotter.py`` + znicz ``NNSnapshotter``
+[SURVEY.md 2.1 "Snapshotter", 3.5, 5.4]: periodic + on-best-validation
+snapshots, optional compression, resume-and-continue.  Re-founded per
+SURVEY.md §7: instead of pickling the live workflow object graph, a snapshot
+is (a) the pure pytree train state (params/velocity/step/rng-key) converted
+to numpy, and (b) an explicit host-state dict (decision, loader, prng
+registry) — so checkpoints survive code refactors and process restarts.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+class _KeyLeaf(NamedTuple):
+    """Pickle-safe stand-in for a typed jax PRNG key leaf."""
+
+    data: np.ndarray
+    impl: str
+
+
+def _to_host(tree):
+    def conv(leaf):
+        if isinstance(leaf, jax.Array) and jnp.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key
+        ):
+            return _KeyLeaf(
+                np.asarray(jax.random.key_data(leaf)),
+                str(jax.random.key_impl(leaf)),
+            )
+        return np.asarray(leaf)
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def _from_host(tree):
+    def conv(leaf):
+        if isinstance(leaf, _KeyLeaf):
+            return jax.random.wrap_key_data(
+                jnp.asarray(leaf.data), impl=leaf.impl
+            )
+        return leaf
+
+    return jax.tree_util.tree_map(
+        conv, tree, is_leaf=lambda x: isinstance(x, _KeyLeaf)
+    )
+
+
+def load_snapshot(path: str) -> Tuple[Any, Dict[str, Any]]:
+    """Read a snapshot file -> (train_state, host_state).  Standalone so a
+    resume never requires a snapshot-writing policy to be configured."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"snapshot {path} has format {payload.get('format_version')}, "
+            f"expected {FORMAT_VERSION}"
+        )
+    return _from_host(payload["train_state"]), payload["host_state"]
+
+
+class Snapshotter:
+    """Write/read snapshots under ``directory`` with a filename ``prefix``.
+
+    ``interval``: also snapshot every N epochs regardless of improvement
+    (0 = only on improvement).  ``keep``: retain at most N non-best snapshots
+    (best is always kept).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        prefix: str = "workflow",
+        *,
+        compress: bool = True,
+        interval: int = 0,
+        keep: int = 3,
+    ):
+        self.directory = directory
+        self.prefix = prefix
+        self.compress = compress
+        self.interval = interval
+        self.keep = keep
+        self._kept: list = []
+        os.makedirs(directory, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+    def _path(self, tag: str) -> str:
+        ext = ".pickle.gz" if self.compress else ".pickle"
+        return os.path.join(self.directory, f"{self.prefix}_{tag}{ext}")
+
+    @property
+    def best_path(self) -> str:
+        return self._path("best")
+
+    # -- save/load -----------------------------------------------------------
+    def save(
+        self,
+        train_state,
+        host_state: Optional[Dict[str, Any]] = None,
+        *,
+        tag: str,
+    ) -> str:
+        payload = {
+            "format_version": FORMAT_VERSION,
+            "train_state": _to_host(train_state),
+            "host_state": host_state or {},
+        }
+        path = self._path(tag)
+        opener = gzip.open if self.compress else open
+        tmp = path + ".tmp"
+        with opener(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+        return path
+
+    def load(self, path: str) -> Tuple[Any, Dict[str, Any]]:
+        return load_snapshot(path)
+
+    def maybe_save(
+        self,
+        train_state,
+        host_state: Optional[Dict[str, Any]] = None,
+        *,
+        epoch: int,
+        improved: bool,
+    ) -> Optional[str]:
+        """Snapshot policy: on validation improvement -> overwrite 'best';
+        every ``interval`` epochs -> tagged periodic snapshot."""
+        path = None
+        if improved:
+            path = self.save(train_state, host_state, tag="best")
+        if self.interval and (epoch + 1) % self.interval == 0:
+            path = self.save(train_state, host_state, tag=f"epoch{epoch}")
+            self._kept.append(path)
+            while len(self._kept) > self.keep:
+                old = self._kept.pop(0)
+                if os.path.exists(old):
+                    os.remove(old)
+        return path

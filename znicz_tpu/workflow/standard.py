@@ -1,0 +1,87 @@
+"""StandardWorkflow: declarative config -> complete training workflow.
+
+Capability parity with ``znicz/standard_workflow.py`` [SURVEY.md 2.3
+"Standard workflow builder"]: the reference builds the
+loader->forwards->evaluator->decision->GD-chain topology from a declarative
+``layers=[{"type": ..., "->": {...}, "<-": {...}}, ...]`` list and wires the
+snapshotter and services.  Here the same config compiles the model
+(:mod:`znicz_tpu.workflow.model`) and assembles a :class:`Workflow`; the GD
+chain is autodiff, so only the forward list is declared — exactly like the
+reference's user-facing API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+from znicz_tpu.loader.base import Loader
+from znicz_tpu.nn import lr_adjust, optimizer
+from znicz_tpu.nn.decision import Decision
+from znicz_tpu.workflow import model as model_lib
+from znicz_tpu.workflow.snapshotter import Snapshotter
+from znicz_tpu.workflow.workflow import Workflow
+
+_HYPER_KEYS = set(optimizer.HyperParams._fields)
+
+
+class StandardWorkflow(Workflow):
+    """Build a full workflow from a layer list.
+
+    ``layers``: reference-style layer specs (the last layer's type picks the
+    loss when ``loss_function`` is not given: "softmax" -> cross-entropy,
+    anything else -> mse).
+    ``decision_config``: kwargs for :class:`Decision` (``max_epochs``,
+    ``fail_iterations``).
+    ``snapshot_dir``/``snapshot_config``: enable the snapshotter.
+    ``lr_policy``: name + kwargs, e.g. ``{"name": "inv", "gamma": 1e-3}``.
+    """
+
+    def __init__(
+        self,
+        loader: Loader,
+        layers: Sequence[Dict[str, Any]],
+        *,
+        loss_function: Optional[str] = None,
+        target: Optional[str] = None,
+        decision_config: Optional[Dict[str, Any]] = None,
+        snapshot_dir: Optional[str] = None,
+        snapshot_config: Optional[Dict[str, Any]] = None,
+        lr_policy: Optional[Dict[str, Any]] = None,
+        default_hyper: Optional[Dict[str, Any]] = None,
+        rand_name: str = "default",
+        name: str = "StandardWorkflow",
+    ):
+        hyper = optimizer.HyperParams(**(default_hyper or {}))
+        mdl = model_lib.build(
+            layers,
+            loader.sample_shape,
+            rand_name=rand_name,
+            default_hyper=hyper,
+        )
+        if loss_function is None:
+            loss_function = "softmax" if mdl.returns_logits else "mse"
+        if target is None:
+            target = "labels" if loss_function == "softmax" else "input"
+        decision = Decision(
+            metric="n_err" if loss_function == "softmax" else "loss",
+            **(decision_config or {}),
+        )
+        snapshotter = None
+        if snapshot_dir:
+            snapshotter = Snapshotter(
+                snapshot_dir, prefix=name, **(snapshot_config or {})
+            )
+        policy = None
+        if lr_policy:
+            kw = dict(lr_policy)
+            policy = lr_adjust.get(kw.pop("name"), **kw)
+        super().__init__(
+            loader,
+            mdl,
+            loss_function=loss_function,
+            target=target,
+            decision=decision,
+            snapshotter=snapshotter,
+            lr_policy=policy,
+            name=name,
+        )

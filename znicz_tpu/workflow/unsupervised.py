@@ -1,0 +1,226 @@
+"""Non-backprop workflows: Kohonen SOM and RBM.
+
+Parity with the reference's non-GD learning paths [SURVEY.md 2.2 rows
+"Kohonen SOM", "RBM"; §7 "Hard parts"]: the learning rule IS the trainer
+(KohonenTrainer's winner-take-all + neighborhood update; rbm_units' CD-k
+updaters), so these workflows replace autodiff with the custom update
+functions from :mod:`znicz_tpu.ops.kohonen` / :mod:`znicz_tpu.ops.rbm`,
+while reusing the loader/decision/snapshotter machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import TRAIN, Loader
+from znicz_tpu.nn.decision import Decision
+from znicz_tpu.nn.train_state import TrainState
+from znicz_tpu.ops import kohonen as kh, rbm as rbm_op
+from znicz_tpu.workflow.snapshotter import Snapshotter
+from znicz_tpu.workflow.workflow import Workflow
+
+
+class _NoModel:
+    """Placeholder satisfying Workflow's model attribute for custom steps."""
+
+    params: list = []
+    hyper: list = []
+
+
+class KohonenWorkflow(Workflow):
+    """Batch-SOM training (znicz/samples/DemoKohonen; BASELINE configs[4]).
+
+    Metric: quantization error (mean squared distance to the winning unit)
+    reported as ``loss`` so Decision/snapshot semantics carry over.
+    """
+
+    def __init__(
+        self,
+        loader: Loader,
+        *,
+        sx: int = 8,
+        sy: int = 8,
+        total_epochs: int = 20,
+        lr0: float = 0.1,
+        lr1: float = 0.01,
+        sigma1: float = 1.0,
+        decision: Optional[Decision] = None,
+        snapshotter: Optional[Snapshotter] = None,
+        rand_name: str = "default",
+        name: str = "KohonenWorkflow",
+    ):
+        super().__init__(
+            loader,
+            _NoModel(),
+            loss_function="mse",
+            target="labels",
+            decision=decision
+            or Decision(metric="loss", max_epochs=total_epochs),
+            snapshotter=snapshotter,
+            name=name,
+        )
+        self.sx, self.sy = sx, sy
+        self.total_epochs = total_epochs
+        self.lr0, self.lr1, self.sigma1 = lr0, lr1, sigma1
+        self.rand_name = rand_name
+        self._n_input = int(jnp.prod(jnp.asarray(loader.sample_shape)))
+
+    def _batch_target(self, mb):
+        return jnp.zeros((len(mb.mask),), jnp.int32)  # unused
+
+    def _build_steps(self):
+        coords = kh.grid_coords(self.sx, self.sy)
+        n_steps_per_epoch = max(self.loader.n_minibatches(TRAIN), 1)
+        total_steps = self.total_epochs * n_steps_per_epoch
+
+        def train_step(state: TrainState, x, y, mask, lr_scale):
+            x = x.reshape(x.shape[0], -1)
+            lr, sigma = kh.decay_schedule(
+                state.step,
+                total_steps,
+                lr0=self.lr0,
+                lr1=self.lr1,
+                sigma1=self.sigma1,
+                sx=self.sx,
+                sy=self.sy,
+            )
+            params, win = kh.train_step(
+                state.params,
+                x,
+                coords,
+                learning_rate=lr * lr_scale,
+                sigma=sigma,
+                mask=mask,
+            )
+            metrics = self._qe(params, x, win, mask)
+            return state._replace(params=params, step=state.step + 1), metrics
+
+        def eval_step(params, x, y, mask):
+            x = x.reshape(x.shape[0], -1)
+            win = kh.winners(params, x)
+            return self._qe(params, x, win, mask)
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step)
+
+    @staticmethod
+    def _qe(params, x, win, mask):
+        d2 = jnp.sum(jnp.square(x - params["weights"][win]), axis=1)
+        n = jnp.maximum(jnp.sum(mask), 1.0)
+        return {
+            "loss": jnp.sum(d2 * mask) / n,
+            "n_samples": n,
+            "n_err": jnp.zeros((), jnp.int32),
+        }
+
+    def initialize(self, *, seed=None, snapshot=None):
+        if seed is not None:
+            prng.seed_all(seed)
+        if self.state is None and not snapshot:
+            params = kh.init_params(
+                self.sx, self.sy, self._n_input, rand_name=self.rand_name
+            )
+            self.state = TrainState.create(
+                params, prng.get("workflow").key()
+            )
+        if snapshot:
+            return Workflow.initialize(self, seed=None, snapshot=snapshot)
+        self._host_step = int(self.state.step)
+        self._build_steps()
+
+    def weights_map(self):
+        """[sy, sx, features] view of the trained map (for plotting)."""
+        import numpy as np
+
+        w = np.asarray(self.state.params["weights"])
+        return w.reshape(self.sy, self.sx, -1)
+
+
+class RBMWorkflow(Workflow):
+    """Bernoulli RBM with CD-k (znicz/samples MNIST RBM; BASELINE configs[2]).
+
+    Metric: masked reconstruction error as ``loss``.
+    """
+
+    def __init__(
+        self,
+        loader: Loader,
+        *,
+        n_hidden: int = 64,
+        learning_rate: float = 0.1,
+        cd_k: int = 1,
+        max_epochs: int = 20,
+        decision: Optional[Decision] = None,
+        snapshotter: Optional[Snapshotter] = None,
+        rand_name: str = "default",
+        name: str = "RBMWorkflow",
+    ):
+        super().__init__(
+            loader,
+            _NoModel(),
+            loss_function="mse",
+            target="labels",
+            decision=decision or Decision(metric="loss", max_epochs=max_epochs),
+            snapshotter=snapshotter,
+            name=name,
+        )
+        self.n_hidden = n_hidden
+        self.learning_rate = learning_rate
+        self.cd_k = cd_k
+        self.rand_name = rand_name
+        self._n_visible = int(jnp.prod(jnp.asarray(loader.sample_shape)))
+
+    def _batch_target(self, mb):
+        return jnp.zeros((len(mb.mask),), jnp.int32)  # unused
+
+    def _build_steps(self):
+        def train_step(state: TrainState, x, y, mask, lr_scale):
+            v0 = x.reshape(x.shape[0], -1)
+            rng = jax.random.fold_in(state.key, state.step)
+            params, err = rbm_op.cd_step(
+                state.params,
+                v0,
+                rng,
+                learning_rate=self.learning_rate * lr_scale,
+                cd_k=self.cd_k,
+                mask=mask,
+            )
+            metrics = {
+                "loss": err,
+                "n_samples": jnp.maximum(jnp.sum(mask), 1.0),
+                "n_err": jnp.zeros((), jnp.int32),
+            }
+            return state._replace(params=params, step=state.step + 1), metrics
+
+        def eval_step(params, x, y, mask):
+            v0 = x.reshape(x.shape[0], -1)
+            v_probs = rbm_op.visible_probs(
+                params, rbm_op.hidden_probs(params, v0)
+            )
+            per = jnp.mean(jnp.square(v0 - v_probs), axis=1)
+            n = jnp.maximum(jnp.sum(mask), 1.0)
+            return {
+                "loss": jnp.sum(per * mask) / n,
+                "n_samples": n,
+                "n_err": jnp.zeros((), jnp.int32),
+            }
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step)
+
+    def initialize(self, *, seed=None, snapshot=None):
+        if seed is not None:
+            prng.seed_all(seed)
+        if self.state is None and not snapshot:
+            params = rbm_op.init_params(
+                self._n_visible, self.n_hidden, rand_name=self.rand_name
+            )
+            self.state = TrainState.create(params, prng.get("workflow").key())
+        if snapshot:
+            return Workflow.initialize(self, seed=None, snapshot=snapshot)
+        self._host_step = int(self.state.step)
+        self._build_steps()

@@ -1,0 +1,244 @@
+"""The Workflow: host control loop around one jit-compiled train step.
+
+Re-founds ``veles/workflow.py``'s event-driven unit DAG (SURVEY.md 3.1) as:
+
+    loader -> [jitted: forward + loss + grad + update + metrics] -> decision
+                                                     \\-> snapshotter
+
+The hot loop (Repeater->Loader->forwards->evaluator->GDs of SURVEY.md 3.1) is
+ONE XLA program; epoch bookkeeping, stopping, snapshots and services stay in
+Python exactly where the reference kept its gate-driven units.  Metric
+device->host syncs happen once per epoch, not per minibatch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.logger import Logger
+from znicz_tpu.loader.base import TRAIN, Loader
+from znicz_tpu.nn import evaluator, optimizer
+from znicz_tpu.nn.decision import Decision
+from znicz_tpu.nn.train_state import TrainState
+from znicz_tpu.workflow.model import Model
+from znicz_tpu.workflow.snapshotter import Snapshotter
+
+
+class Workflow(Logger):
+    """Owns loader + model + decision + snapshotter; runs training.
+
+    ``loss_function``: "softmax" (cross-entropy on integer labels) or "mse"
+    (against ``target`` = "targets" from the loader, or "input" for
+    autoencoders) — mirroring EvaluatorSoftmax / EvaluatorMSE.
+    """
+
+    def __init__(
+        self,
+        loader: Loader,
+        model: Model,
+        *,
+        loss_function: str = "softmax",
+        target: str = "labels",
+        decision: Optional[Decision] = None,
+        snapshotter: Optional[Snapshotter] = None,
+        lr_policy: Optional[Callable[[float, int], float]] = None,
+        parallel=None,
+        name: str = "workflow",
+    ):
+        self.loader = loader
+        self.model = model
+        self.loss_function = loss_function
+        self.target = target
+        self.decision = decision or Decision(
+            metric="n_err" if loss_function == "softmax" else "loss"
+        )
+        self.snapshotter = snapshotter
+        self.lr_policy = lr_policy
+        self.parallel = parallel  # DataParallel placement policy, or None
+        self.services = []  # per-epoch observers: plotters, status, image saver
+        self.name = name
+        self.state: Optional[TrainState] = None
+        self._train_step = None
+        self._eval_step = None
+        self._host_step = 0
+
+    # ------------------------------------------------------------------
+    def _metrics(self, out, y, mask):
+        if self.loss_function == "softmax":
+            return evaluator.softmax(out, y, mask=mask)
+        return evaluator.mse(out, y, mask=mask)
+
+    def _build_steps(self):
+        model = self.model
+
+        def loss_fn(params, key, step, x, y, mask):
+            rng = jax.random.fold_in(key, step)
+            out = model.apply(params, x, train=True, rng=rng)
+            m = self._metrics(out, y, mask)
+            return m["loss"], m
+
+        def train_step(state: TrainState, x, y, mask, lr_scale):
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(
+                state.params, state.key, state.step, x, y, mask
+            )
+            hyper = [
+                h._replace(
+                    learning_rate=h.learning_rate * lr_scale,
+                    learning_rate_bias=(
+                        None
+                        if h.learning_rate_bias is None
+                        else h.learning_rate_bias * lr_scale
+                    ),
+                )
+                for h in model.hyper
+            ]
+            new_p, new_v = optimizer.update(
+                state.params, grads, state.velocity, hyper
+            )
+            return (
+                state._replace(
+                    params=new_p, velocity=new_v, step=state.step + 1
+                ),
+                metrics,
+            )
+
+        def eval_step(params, x, y, mask):
+            out = model.apply(params, x, train=False)
+            return self._metrics(out, y, mask)
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    def initialize(
+        self,
+        *,
+        seed: Optional[int] = None,
+        snapshot: Optional[str] = None,
+    ) -> None:
+        """Create (or resume) the train state and compile the steps."""
+        if seed is not None:
+            prng.seed_all(seed)
+        if snapshot:
+            from znicz_tpu.workflow.snapshotter import load_snapshot
+
+            state, host = load_snapshot(snapshot)
+            self.state = TrainState(*state)
+            if "decision" in host:
+                self.decision.load_state_dict(host["decision"])
+            if "loader" in host:
+                self.loader.load_state_dict(host["loader"])
+            if "prng" in host:
+                prng.load_state_dict(host["prng"])
+            self.info(
+                "resumed from %s at epoch %d", snapshot, self.decision.epoch
+            )
+        else:
+            self.state = TrainState.create(
+                self.model.params, prng.get("workflow").key()
+            )
+        if self.parallel is not None:
+            self.state = self.parallel.shard_state(self.state)
+        # host-side mirror of state.step: lr policies read it every minibatch
+        # and must not force a device sync in the hot loop
+        self._host_step = int(self.state.step)
+        self._build_steps()
+
+    def _batch_target(self, mb):
+        if self.target == "labels":
+            return jnp.asarray(mb.labels)
+        if self.target == "targets":
+            return jnp.asarray(mb.targets)
+        if self.target == "input":
+            # autoencoder: reconstruct the input; evaluator.mse flattens, so
+            # the model output only needs to match total feature count
+            return jnp.asarray(mb.data)
+        raise ValueError(f"unknown target {self.target!r}")
+
+    def host_state(self) -> Dict[str, Any]:
+        return {
+            "decision": self.decision.state_dict(),
+            "loader": self.loader.state_dict(),
+            "prng": prng.state_dict(),
+        }
+
+    # ------------------------------------------------------------------
+    def run_epoch(self) -> Dict[str, Any]:
+        """One full epoch over all splits; returns the Decision verdict."""
+        if self.state is None:
+            self.initialize()
+        pending = []  # (split, device-side metrics) — sync once at epoch end
+        put = (
+            self.parallel.shard_batch if self.parallel is not None else jnp.asarray
+        )
+        for split, mb in self.loader.epoch():
+            x = put(mb.data)
+            y = put(self._batch_target(mb))
+            mask = put(mb.mask)
+            if split == TRAIN:
+                lr_scale = (
+                    self.lr_policy(1.0, self._host_step)
+                    if self.lr_policy
+                    else 1.0
+                )
+                self.state, metrics = self._train_step(
+                    self.state, x, y, mask, lr_scale
+                )
+                self._host_step += 1
+            else:
+                metrics = self._eval_step(self.state.params, x, y, mask)
+            pending.append((split, metrics))
+        for split, metrics in jax.device_get(pending):
+            self.decision.add_minibatch(
+                split, {k: float(v) for k, v in metrics.items()}
+            )
+        verdict = self.decision.on_epoch_end()
+        if self.snapshotter is not None:
+            self.snapshotter.maybe_save(
+                self.state,
+                self.host_state(),
+                epoch=self.decision.epoch - 1,
+                improved=verdict["improved"],
+            )
+        for service in self.services:
+            try:
+                service.on_epoch(self, verdict)
+            except Exception:  # services must never kill training
+                self.logger.exception(
+                    "service %s failed", type(service).__name__
+                )
+        return verdict
+
+    def run(self) -> Decision:
+        """Train until the Decision stops; returns it (history, best)."""
+        if self.state is None:
+            self.initialize()
+        t0 = time.time()
+        while True:
+            verdict = self.run_epoch()
+            s = verdict["summary"]
+            parts = [
+                f"{split} err={m['err_pct']:.2f}% loss={m['loss']:.4f}"
+                if self.loss_function == "softmax"
+                else f"{split} loss={m['loss']:.6f}"
+                for split, m in s.items()
+            ]
+            self.info(
+                "epoch %d [%.1fs]: %s%s",
+                self.decision.epoch - 1,
+                time.time() - t0,
+                "; ".join(parts),
+                " *" if verdict["improved"] else "",
+            )
+            if verdict["stop"]:
+                self.info(
+                    "stopping: best=%s at epoch %d",
+                    verdict["best_value"],
+                    verdict["best_epoch"],
+                )
+                return self.decision
