@@ -1,0 +1,500 @@
+// znicz_infer: C++ forward-inference engine for exported znicz-tpu models.
+//
+// Capability parity with the reference's libVeles/libZnicz (SURVEY.md 2.1,
+// 2.3, 2.4): load a trained snapshot, run forward passes without Python.
+// Reads the ZNICZT01 format written by znicz_tpu/export.py and executes the
+// layer list on CPU (NHWC layouts matching the Python ops).
+//
+// Usage:
+//   znicz_infer MODEL.znicz INPUT.f32 OUTPUT.f32 [batch]
+//     INPUT.f32: raw little-endian float32, batch x input_shape
+//     OUTPUT.f32: raw float32 written back, batch x output_shape
+//   znicz_infer MODEL.znicz --describe
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser (objects, arrays, strings, numbers, bools) — the
+// header is machine-generated so this only needs to be correct, not lenient.
+// ---------------------------------------------------------------------------
+struct Json {
+  enum Kind { OBJECT, ARRAY, STRING, NUMBER, BOOL, NUL } kind = NUL;
+  std::map<std::string, Json> object;
+  std::vector<Json> array;
+  std::string str;
+  double number = 0;
+  bool boolean = false;
+
+  const Json& at(const std::string& key) const {
+    auto it = object.find(key);
+    if (it == object.end()) {
+      throw std::runtime_error("missing JSON key: " + key);
+    }
+    return it->second;
+  }
+  bool has(const std::string& key) const { return object.count(key) > 0; }
+  int as_int() const { return static_cast<int>(number); }
+  float as_float() const { return static_cast<float>(number); }
+  std::vector<int> as_int_array() const {
+    std::vector<int> out;
+    for (const auto& v : array) out.push_back(v.as_int());
+    return out;
+  }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+
+  explicit JsonParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r' || *p == ',' || *p == ':')) ++p;
+  }
+  Json parse() {
+    skip_ws();
+    if (p >= end) throw std::runtime_error("unexpected end of JSON");
+    switch (*p) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return parse_string();
+      case 't': case 'f': return parse_bool();
+      case 'n': p += 4; return Json{};
+      default: return parse_number();
+    }
+  }
+  Json parse_object() {
+    Json j; j.kind = Json::OBJECT;
+    ++p;  // {
+    skip_ws();
+    while (p < end && *p != '}') {
+      Json key = parse_string();
+      skip_ws();
+      j.object[key.str] = parse();
+      skip_ws();
+    }
+    ++p;  // }
+    return j;
+  }
+  Json parse_array() {
+    Json j; j.kind = Json::ARRAY;
+    ++p;  // [
+    skip_ws();
+    while (p < end && *p != ']') {
+      j.array.push_back(parse());
+      skip_ws();
+    }
+    ++p;  // ]
+    return j;
+  }
+  Json parse_string() {
+    Json j; j.kind = Json::STRING;
+    ++p;  // "
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) ++p;
+      j.str += *p++;
+    }
+    ++p;  // "
+    return j;
+  }
+  Json parse_bool() {
+    Json j; j.kind = Json::BOOL;
+    if (*p == 't') { j.boolean = true; p += 4; } else { j.boolean = false; p += 5; }
+    return j;
+  }
+  Json parse_number() {
+    Json j; j.kind = Json::NUMBER;
+    char* next = nullptr;
+    j.number = std::strtod(p, &next);
+    p = next;
+    return j;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Tensor: NHWC float32 on the heap
+// ---------------------------------------------------------------------------
+struct Tensor {
+  std::vector<int> shape;  // [N, ...]
+  std::vector<float> data;
+
+  int64_t size() const {
+    int64_t s = 1;
+    for (int d : shape) s *= d;
+    return s;
+  }
+  int dim(int i) const { return shape[i]; }
+};
+
+struct Padding { int left = 0, top = 0, right = 0, bottom = 0; };
+
+Padding read_padding(const Json& cfg) {
+  Padding p;
+  if (!cfg.has("padding")) return p;
+  const Json& pj = cfg.at("padding");
+  if (pj.kind != Json::ARRAY)
+    throw std::runtime_error(
+        "unsupported padding encoding (expected [l,t,r,b]); re-export with "
+        "explicit padding");
+  auto v = pj.as_int_array();
+  if (v.size() == 2) { p.left = v[0]; p.top = v[1]; p.right = v[0]; p.bottom = v[1]; }
+  else if (v.size() == 4) { p.left = v[0]; p.top = v[1]; p.right = v[2]; p.bottom = v[3]; }
+  else throw std::runtime_error("padding must have 2 or 4 entries");
+  return p;
+}
+
+void read_sliding(const Json& cfg, int* sx, int* sy, int def_x, int def_y) {
+  *sx = def_x; *sy = def_y;
+  if (cfg.has("sliding")) {
+    auto v = cfg.at("sliding").as_int_array();
+    if (v.size() == 2) { *sx = v[0]; *sy = v[1]; }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Ops (match znicz_tpu/ops/*.py semantics)
+// ---------------------------------------------------------------------------
+void apply_activation(const std::string& type, Tensor* t) {
+  // semantics match znicz_tpu/ops/activation.py (reference znicz):
+  // "tanh" is the scaled 1.7159*tanh(0.6666x); "relu" is smooth softplus;
+  // "strict_relu"/"str" is max(0, x).
+  if (type.find("_tanh") != std::string::npos) {
+    for (auto& v : t->data) v = 1.7159f * std::tanh(0.6666f * v);
+  } else if (type.find("_str") != std::string::npos) {
+    for (auto& v : t->data) v = v > 0 ? v : 0;
+  } else if (type.find("_relu") != std::string::npos) {
+    for (auto& v : t->data)
+      v = v > 0 ? v + std::log1p(std::exp(-v)) : std::log1p(std::exp(v));
+  } else if (type.find("_sigmoid") != std::string::npos) {
+    for (auto& v : t->data) v = 1.0f / (1.0f + std::exp(-v));
+  } else if (type.find("_log") != std::string::npos) {
+    for (auto& v : t->data) v = std::asinh(v);
+  }
+}
+
+// FC: x [N, F] @ w [F, O] + b
+Tensor all2all(const Tensor& x, const float* w, const float* b,
+               int n_in, int n_out, bool include_bias) {
+  int n = x.dim(0);
+  Tensor y;
+  y.shape = {n, n_out};
+  y.data.assign(static_cast<size_t>(n) * n_out, 0.0f);
+  for (int i = 0; i < n; ++i) {
+    const float* xi = x.data.data() + static_cast<int64_t>(i) * n_in;
+    float* yi = y.data.data() + static_cast<int64_t>(i) * n_out;
+    for (int f = 0; f < n_in; ++f) {
+      float xv = xi[f];
+      if (xv == 0.0f) continue;
+      const float* wf = w + static_cast<int64_t>(f) * n_out;
+      for (int o = 0; o < n_out; ++o) yi[o] += xv * wf[o];
+    }
+    if (include_bias && b) {
+      for (int o = 0; o < n_out; ++o) yi[o] += b[o];
+    }
+  }
+  return y;
+}
+
+// Conv: x [N,H,W,C], w [ky,kx,C,K] (HWIO), NHWC out
+Tensor conv2d(const Tensor& x, const float* w, const float* b,
+              int kx, int ky, int n_kernels, int sx, int sy, Padding pad) {
+  int n = x.dim(0), h = x.dim(1), wd = x.dim(2), c = x.dim(3);
+  int oh = (h + pad.top + pad.bottom - ky) / sy + 1;
+  int ow = (wd + pad.left + pad.right - kx) / sx + 1;
+  Tensor y;
+  y.shape = {n, oh, ow, n_kernels};
+  y.data.assign(static_cast<size_t>(n) * oh * ow * n_kernels, 0.0f);
+  for (int ni = 0; ni < n; ++ni) {
+    for (int oy = 0; oy < oh; ++oy) {
+      for (int ox = 0; ox < ow; ++ox) {
+        float* out = y.data.data() +
+            ((static_cast<int64_t>(ni) * oh + oy) * ow + ox) * n_kernels;
+        for (int dy = 0; dy < ky; ++dy) {
+          int iy = oy * sy + dy - pad.top;
+          if (iy < 0 || iy >= h) continue;
+          for (int dx = 0; dx < kx; ++dx) {
+            int ix = ox * sx + dx - pad.left;
+            if (ix < 0 || ix >= wd) continue;
+            const float* in = x.data.data() +
+                ((static_cast<int64_t>(ni) * h + iy) * wd + ix) * c;
+            const float* wk = w +
+                (static_cast<int64_t>(dy) * kx + dx) * c * n_kernels;
+            for (int ci = 0; ci < c; ++ci) {
+              float xv = in[ci];
+              const float* wc = wk + static_cast<int64_t>(ci) * n_kernels;
+              for (int k = 0; k < n_kernels; ++k) out[k] += xv * wc[k];
+            }
+          }
+        }
+        if (b) for (int k = 0; k < n_kernels; ++k) out[k] += b[k];
+      }
+    }
+  }
+  return y;
+}
+
+// Stochastic pooling at inference: probability-weighted expectation over the
+// positive mass (matches ops/pooling.py stochastic_pool(train=False)).
+Tensor stochastic_pool_eval(const Tensor& x, int kx, int ky, int sx, int sy) {
+  int n = x.dim(0), h = x.dim(1), w = x.dim(2), c = x.dim(3);
+  int oh = (h - ky) / sy + 1;
+  int ow = (w - kx) / sx + 1;
+  Tensor y;
+  y.shape = {n, oh, ow, c};
+  y.data.assign(static_cast<size_t>(n) * oh * ow * c, 0.0f);
+  for (int ni = 0; ni < n; ++ni)
+    for (int oy = 0; oy < oh; ++oy)
+      for (int ox = 0; ox < ow; ++ox)
+        for (int ci = 0; ci < c; ++ci) {
+          float total = 0.0f, acc = 0.0f;
+          for (int dy = 0; dy < ky; ++dy)
+            for (int dx = 0; dx < kx; ++dx) {
+              int iy = oy * sy + dy, ix = ox * sx + dx;
+              float v = x.data[((static_cast<int64_t>(ni) * h + iy) * w + ix) * c + ci];
+              float pos = v > 0 ? v : 0.0f;
+              total += pos;
+              acc += pos * v;
+            }
+          y.data[((static_cast<int64_t>(ni) * oh + oy) * ow + ox) * c + ci] =
+              total > 0 ? acc / total : 0.0f;
+        }
+  return y;
+}
+
+Tensor pool2d(const Tensor& x, int kx, int ky, int sx, int sy, bool is_max,
+              bool max_abs = false) {
+  int n = x.dim(0), h = x.dim(1), w = x.dim(2), c = x.dim(3);
+  int oh = (h - ky) / sy + 1;
+  int ow = (w - kx) / sx + 1;
+  Tensor y;
+  y.shape = {n, oh, ow, c};
+  y.data.assign(static_cast<size_t>(n) * oh * ow * c, 0.0f);
+  for (int ni = 0; ni < n; ++ni) {
+    for (int oy = 0; oy < oh; ++oy) {
+      for (int ox = 0; ox < ow; ++ox) {
+        for (int ci = 0; ci < c; ++ci) {
+          float best = is_max ? -1e30f : 0.0f;
+          float best_abs = -1.0f;
+          float acc = 0.0f;
+          for (int dy = 0; dy < ky; ++dy) {
+            for (int dx = 0; dx < kx; ++dx) {
+              int iy = oy * sy + dy, ix = ox * sx + dx;
+              float v = x.data[((static_cast<int64_t>(ni) * h + iy) * w + ix) * c + ci];
+              if (is_max) {
+                if (max_abs) {
+                  if (std::fabs(v) > best_abs) { best_abs = std::fabs(v); best = v; }
+                } else if (v > best) {
+                  best = v;
+                }
+              } else {
+                acc += v;
+              }
+            }
+          }
+          y.data[((static_cast<int64_t>(ni) * oh + oy) * ow + ox) * c + ci] =
+              is_max ? best : acc / (kx * ky);
+        }
+      }
+    }
+  }
+  return y;
+}
+
+// Cross-channel LRN, SAME window (matches ops/normalization.py)
+Tensor lrn(const Tensor& x, float alpha, float beta, float k, int n_window) {
+  Tensor y = x;
+  int c = x.shape.back();
+  int64_t rows = x.size() / c;
+  int half = n_window / 2;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* in = x.data.data() + r * c;
+    float* out = y.data.data() + r * c;
+    for (int ci = 0; ci < c; ++ci) {
+      float s = 0.0f;
+      int lo = ci - half, hi = ci + (n_window - 1 - half);
+      if (lo < 0) lo = 0;
+      if (hi >= c) hi = c - 1;
+      for (int j = lo; j <= hi; ++j) s += in[j] * in[j];
+      out[ci] = in[ci] * std::pow(k + alpha * s, -beta);
+    }
+  }
+  return y;
+}
+
+void softmax_rows(Tensor* t) {
+  int c = t->shape.back();
+  int64_t rows = t->size() / c;
+  for (int64_t r = 0; r < rows; ++r) {
+    float* row = t->data.data() + r * c;
+    float mx = row[0];
+    for (int i = 1; i < c; ++i) mx = std::max(mx, row[i]);
+    float sum = 0;
+    for (int i = 0; i < c; ++i) { row[i] = std::exp(row[i] - mx); sum += row[i]; }
+    for (int i = 0; i < c; ++i) row[i] /= sum;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Model
+// ---------------------------------------------------------------------------
+struct Layer {
+  std::string type;
+  Json config;
+  std::map<std::string, std::pair<std::vector<int>, const float*>> params;
+};
+
+struct Model {
+  Json header;
+  std::vector<char> blob;
+  std::vector<Layer> layers;
+  std::vector<int> input_shape;
+  std::string output_kind = "raw";
+
+  static Model load(const std::string& path) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) throw std::runtime_error("cannot open " + path);
+    char magic[8];
+    f.read(magic, 8);
+    if (std::memcmp(magic, "ZNICZT01", 8) != 0)
+      throw std::runtime_error("bad magic in " + path);
+    uint32_t hlen = 0;
+    f.read(reinterpret_cast<char*>(&hlen), 4);
+    std::string hjson(hlen, '\0');
+    f.read(hjson.data(), hlen);
+    Model m;
+    m.header = JsonParser(hjson).parse();
+    m.blob.assign(std::istreambuf_iterator<char>(f),
+                  std::istreambuf_iterator<char>());
+    m.input_shape = m.header.at("input_shape").as_int_array();
+    if (m.header.has("output_kind"))
+      m.output_kind = m.header.at("output_kind").str;
+    for (const auto& lj : m.header.at("layers").array) {
+      Layer layer;
+      layer.type = lj.at("type").str;
+      layer.config = lj.at("config");
+      for (const auto& [name, pj] : lj.at("params").object) {
+        int64_t offset = static_cast<int64_t>(pj.at("offset").number);
+        layer.params[name] = {
+            pj.at("shape").as_int_array(),
+            reinterpret_cast<const float*>(m.blob.data() + offset)};
+      }
+      m.layers.push_back(std::move(layer));
+    }
+    return m;
+  }
+
+  Tensor forward(Tensor x) const {
+    for (const auto& layer : layers) {
+      const std::string& t = layer.type;
+      const Json& cfg = layer.config;
+      if (t.rfind("all2all", 0) == 0 || t == "softmax") {
+        const auto& wp = layer.params.at("weights");
+        int n_in = wp.first[0], n_out = wp.first[1];
+        // flatten trailing dims
+        x.shape = {x.dim(0), static_cast<int>(x.size() / x.dim(0))};
+        bool include_bias = !cfg.has("include_bias") ||
+                            cfg.at("include_bias").boolean;
+        const float* b = layer.params.count("bias")
+                             ? layer.params.at("bias").second
+                             : nullptr;
+        x = all2all(x, wp.second, b, n_in, n_out, include_bias);
+        apply_activation(t, &x);
+        if (t == "softmax") softmax_rows(&x);
+      } else if (t.rfind("conv", 0) == 0) {
+        const auto& wp = layer.params.at("weights");
+        int ky = wp.first[0], kx = wp.first[1], k = wp.first[3];
+        int sx, sy;
+        read_sliding(cfg, &sx, &sy, 1, 1);
+        const float* b = layer.params.count("bias")
+                             ? layer.params.at("bias").second
+                             : nullptr;
+        x = conv2d(x, wp.second, b, kx, ky, k, sx, sy, read_padding(cfg));
+        apply_activation(t, &x);
+      } else if (t == "max_pooling" || t == "avg_pooling" ||
+                 t == "maxabs_pooling" || t == "stochastic_pooling") {
+        int kx = cfg.at("kx").as_int(), ky = cfg.at("ky").as_int();
+        int sx, sy;
+        read_sliding(cfg, &sx, &sy, kx, ky);
+        if (t == "stochastic_pooling") {
+          x = stochastic_pool_eval(x, kx, ky, sx, sy);
+        } else {
+          bool is_max = (t == "max_pooling" || t == "maxabs_pooling");
+          x = pool2d(x, kx, ky, sx, sy, is_max, t == "maxabs_pooling");
+        }
+      } else if (t == "norm") {
+        float alpha = cfg.has("alpha") ? cfg.at("alpha").as_float() : 1e-4f;
+        float beta = cfg.has("beta") ? cfg.at("beta").as_float() : 0.75f;
+        float k = cfg.has("k") ? cfg.at("k").as_float() : 2.0f;
+        int n = cfg.has("n") ? cfg.at("n").as_int() : 5;
+        x = lrn(x, alpha, beta, k, n);
+      } else if (t == "dropout") {
+        // inference no-op (inverted dropout)
+      } else if (t.rfind("activation_", 0) == 0) {
+        std::string suffix = "_" + t.substr(11);
+        apply_activation(suffix, &x);
+      } else {
+        throw std::runtime_error("znicz_infer: unsupported layer type " + t);
+      }
+    }
+    return x;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::cerr << "usage: " << argv[0]
+              << " MODEL.znicz (INPUT.f32 OUTPUT.f32 [batch] | --describe)\n";
+    return 2;
+  }
+  try {
+    Model model = Model::load(argv[1]);
+    if (std::string(argv[2]) == "--describe") {
+      std::cout << "input_shape:";
+      for (int d : model.input_shape) std::cout << " " << d;
+      std::cout << "\noutput_kind: " << model.output_kind;
+      std::cout << "\nlayers:";
+      for (const auto& l : model.layers) std::cout << " " << l.type;
+      std::cout << "\n";
+      return 0;
+    }
+    if (argc < 4) {
+      std::cerr << "missing OUTPUT.f32\n";
+      return 2;
+    }
+    int batch = argc > 4 ? std::atoi(argv[4]) : 1;
+    int64_t per_sample = 1;
+    for (int d : model.input_shape) per_sample *= d;
+    Tensor x;
+    x.shape = {batch};
+    for (int d : model.input_shape) x.shape.push_back(d);
+    x.data.resize(batch * per_sample);
+    std::ifstream in(argv[2], std::ios::binary);
+    if (!in) throw std::runtime_error(std::string("cannot open ") + argv[2]);
+    in.read(reinterpret_cast<char*>(x.data.data()),
+            x.data.size() * sizeof(float));
+    if (in.gcount() != static_cast<std::streamsize>(x.data.size() * sizeof(float)))
+      throw std::runtime_error("input file too small for batch");
+    Tensor y = model.forward(std::move(x));
+    std::ofstream out(argv[3], std::ios::binary);
+    out.write(reinterpret_cast<const char*>(y.data.data()),
+              y.data.size() * sizeof(float));
+    std::cerr << "ok: wrote " << y.size() << " floats\n";
+    return 0;
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+}

@@ -1,0 +1,130 @@
+"""Tests for ensembles, publishing, profiling, and the small aux ops."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.ensemble import Ensemble
+from znicz_tpu.loader import datasets
+from znicz_tpu.ops import (
+    accumulator,
+    resizable_all2all,
+    weights_zerofilling as wzf,
+)
+from znicz_tpu.services.publishing import MarkdownReporter
+from znicz_tpu.utils.profiling import StepTimer
+from znicz_tpu.workflow import StandardWorkflow
+
+MLP_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 16}},
+    {"type": "softmax", "->": {"output_sample_shape": 10}},
+]
+
+
+def _build():
+    loader = datasets.mnist(n_train=128, n_test=64, minibatch_size=64)
+    return StandardWorkflow(
+        loader,
+        MLP_LAYERS,
+        decision_config={"max_epochs": 2},
+        default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+    )
+
+
+class TestEnsemble:
+    def test_train_and_aggregate(self):
+        ens = Ensemble(_build, n_models=3, base_seed=50)
+        decisions = ens.train()
+        assert len(decisions) == 3 and len(ens.workflows) == 3
+        # members differ (different seeds)
+        w0 = np.asarray(ens.workflows[0].state.params[0]["weights"])
+        w1 = np.asarray(ens.workflows[1].state.params[0]["weights"])
+        assert not np.allclose(w0, w1)
+        result = ens.evaluate("test")
+        assert result["n_samples"] == 64
+        assert 0.0 <= result["ensemble_err_pct"] <= 100.0
+
+    def test_soft_and_hard_vote_shapes(self):
+        ens = Ensemble(_build, n_models=2, base_seed=60)
+        ens.train()
+        x = ens.workflows[0].loader.data["test"][:10]
+        assert ens.predict(x, vote="soft").shape == (10,)
+        assert ens.predict(x, vote="hard").shape == (10,)
+        probs = ens.predict_proba(x)
+        np.testing.assert_allclose(np.asarray(probs.sum(axis=1)), 1.0, rtol=1e-5)
+
+
+class TestPublishing:
+    def test_report_written_on_stop(self, tmp_path):
+        prng.seed_all(9)
+        wf = _build()
+        wf.services = [MarkdownReporter(str(tmp_path))]
+        wf.initialize(seed=9)
+        wf.run()
+        report = (tmp_path / "report.md").read_text()
+        assert "# Run report" in report
+        assert "all2all_tanh" in report
+        assert "| epoch |" in report
+        assert (tmp_path / "report.json").exists()
+
+
+class TestProfiling:
+    def test_step_timer(self):
+        t = StepTimer()
+        with t.phase("a"):
+            pass
+        with t.phase("a"):
+            pass
+        with t.phase("b"):
+            pass
+        s = t.summary()
+        assert s["a"]["count"] == 2 and s["b"]["count"] == 1
+        t.reset()
+        assert t.summary() == {}
+
+
+class TestAuxOps:
+    def test_resizable_grow_preserves_overlap(self):
+        prng.seed_all(4)
+        p = resizable_all2all.init_params(8, 4)
+        grown = resizable_all2all.resize(p, 6)
+        assert grown["weights"].shape == (8, 6)
+        np.testing.assert_array_equal(grown["weights"][:, :4], p["weights"])
+        np.testing.assert_array_equal(grown["bias"][:4], p["bias"])
+        shrunk = resizable_all2all.resize(p, 2)
+        np.testing.assert_array_equal(shrunk["weights"], p["weights"][:, :2])
+        assert resizable_all2all.resize(p, 4) is p
+
+    def test_accumulator_stats(self):
+        stats = accumulator.init(3)
+        x1 = jnp.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        stats = accumulator.update(stats, x1)
+        x2 = jnp.array([[-1.0, 0.0, 10.0], [99.0, 99.0, 99.0]])
+        stats = accumulator.update(stats, x2, mask=jnp.array([1.0, 0.0]))
+        np.testing.assert_allclose(stats.lo, [-1.0, 0.0, 3.0])
+        np.testing.assert_allclose(stats.hi, [4.0, 5.0, 10.0])
+        np.testing.assert_allclose(stats.mean, [4 / 3, 7 / 3, 19 / 3], rtol=1e-6)
+        assert float(stats.count) == 3.0
+
+    def test_zerofill_group_mask_and_update_wrap(self):
+        mask = wzf.make_group_mask(4, 6, 2)
+        assert mask.shape == (4, 6)
+        np.testing.assert_array_equal(mask[:2, 3:], 0.0)
+        np.testing.assert_array_equal(mask[:2, :3], 1.0)
+
+        from znicz_tpu.nn import optimizer
+
+        params = [{"weights": jnp.ones((4, 6))}]
+        grads = [{"weights": jnp.ones((4, 6))}]
+        vel = [{"weights": jnp.zeros((4, 6))}]
+        update = wzf.masked_update(
+            optimizer.update, {0: {"weights": mask}}
+        )
+        new_p, _ = update(
+            params, grads, vel, optimizer.HyperParams(learning_rate=0.1)
+        )
+        # masked entries exactly zero, others updated
+        np.testing.assert_array_equal(np.asarray(new_p[0]["weights"])[:2, 3:], 0.0)
+        np.testing.assert_allclose(np.asarray(new_p[0]["weights"])[:2, :3], 0.9)

@@ -94,6 +94,34 @@ class TestModelZoo:
         dec = wf.run()
         assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
 
+    def test_kanji_model(self):
+        prng.seed_all(1234)
+        kanji = _fresh("kanji")
+        root.kanji.loader.update({"n_train": 200, "n_test": 50})
+        wf = kanji.build_workflow(decision_config={"max_epochs": 2})
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
+
+    def test_yale_faces_model(self):
+        prng.seed_all(1234)
+        yf = _fresh("yale_faces")
+        root.yale_faces.loader.update({"n_train": 150, "n_test": 30})
+        wf = yf.build_workflow(decision_config={"max_epochs": 2})
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
+
+    def test_video_ae_model(self):
+        prng.seed_all(1234)
+        vae = _fresh("video_ae")
+        root.video_ae.loader.update({"n_sequences": 5, "frames_per_seq": 20})
+        wf = vae.build_workflow(decision_config={"max_epochs": 3})
+        assert wf.loss_function == "mse"
+        wf.initialize(seed=1234)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
+
     def test_alexnet_builds(self):
         # full run is the bench's job; here: builds + one forward shape check
         prng.seed_all(1234)

@@ -1,0 +1,123 @@
+"""Native C++ inference engine vs the Python model (libZnicz parity).
+
+Builds native/znicz_infer with g++ once per session, exports trained-ish
+models through znicz_tpu.export, and cross-checks forward outputs — the
+deployment-path analog of the golden kernel tests (SURVEY.md §4, 2.4).
+"""
+
+import os
+import subprocess
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.export import export_model
+from znicz_tpu.workflow import build
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="session")
+def znicz_infer(tmp_path_factory):
+    exe = str(tmp_path_factory.mktemp("native") / "znicz_infer")
+    subprocess.run(
+        [
+            "g++", "-O2", "-std=c++17",
+            os.path.join(REPO, "native", "znicz_infer.cc"),
+            "-o", exe,
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return exe
+
+
+def _roundtrip(znicz_infer, tmp_path, model, x):
+    model_path = str(tmp_path / "model.znicz")
+    export_model(model, model_path)
+    in_path = str(tmp_path / "in.f32")
+    out_path = str(tmp_path / "out.f32")
+    np.asarray(x, np.float32).tofile(in_path)
+    subprocess.run(
+        [znicz_infer, model_path, in_path, out_path, str(x.shape[0])],
+        check=True,
+        capture_output=True,
+    )
+    y = np.fromfile(out_path, np.float32)
+    return y.reshape((x.shape[0],) + model.output_shape)
+
+
+class TestNativeInference:
+    def test_mlp_matches_python(self, znicz_infer, tmp_path):
+        prng.seed_all(3)
+        model = build(
+            [
+                {"type": "all2all_tanh", "->": {"output_sample_shape": 32}},
+                {"type": "softmax", "->": {"output_sample_shape": 10}},
+            ],
+            (64,),
+        )
+        x = np.asarray(
+            prng.get("t").normal((5, 64)), np.float32
+        )
+        y_py = np.asarray(model.predict(model.params, jnp.asarray(x)))
+        y_cc = _roundtrip(znicz_infer, tmp_path, model, x)
+        np.testing.assert_allclose(y_cc, y_py, rtol=1e-4, atol=1e-5)
+
+    def test_conv_stack_matches_python(self, znicz_infer, tmp_path):
+        prng.seed_all(4)
+        model = build(
+            [
+                {
+                    "type": "conv_relu",
+                    "->": {
+                        "n_kernels": 8, "kx": 3, "ky": 3,
+                        "padding": (1, 1, 1, 1), "sliding": (2, 2),
+                    },
+                },
+                {"type": "norm", "->": {"n": 5}},
+                {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+                {"type": "avg_pooling", "->": {"kx": 2, "ky": 2}},
+                {"type": "all2all_sigmoid", "->": {"output_sample_shape": 7}},
+            ],
+            (16, 16, 3),
+        )
+        x = np.asarray(
+            prng.get("t").normal((3, 16, 16, 3)), np.float32
+        )
+        y_py = np.asarray(model.apply(model.params, jnp.asarray(x)))
+        y_cc = _roundtrip(znicz_infer, tmp_path, model, x)
+        np.testing.assert_allclose(y_cc, y_py, rtol=1e-3, atol=1e-4)
+
+    def test_dropout_is_inference_noop(self, znicz_infer, tmp_path):
+        prng.seed_all(5)
+        model = build(
+            [
+                {"type": "all2all_str", "->": {"output_sample_shape": 16}},
+                {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+                {"type": "all2all", "->": {"output_sample_shape": 4}},
+            ],
+            (8,),
+        )
+        x = np.asarray(prng.get("t").normal((2, 8)), np.float32)
+        y_py = np.asarray(model.apply(model.params, jnp.asarray(x)))
+        y_cc = _roundtrip(znicz_infer, tmp_path, model, x)
+        np.testing.assert_allclose(y_cc, y_py, rtol=1e-4, atol=1e-5)
+
+    def test_describe(self, znicz_infer, tmp_path):
+        prng.seed_all(6)
+        model = build(
+            [{"type": "softmax", "->": {"output_sample_shape": 3}}], (5,)
+        )
+        path = str(tmp_path / "m.znicz")
+        export_model(model, path)
+        out = subprocess.run(
+            [znicz_infer, path, "--describe"],
+            check=True,
+            capture_output=True,
+            text=True,
+        ).stdout
+        assert "input_shape: 5" in out
+        assert "softmax" in out

@@ -109,10 +109,25 @@ class TestEvaluators:
         out = jnp.array([[1.0, 1.0], [0.0, 0.0]])
         tgt = jnp.array([[0.0, 0.0], [0.0, 0.0]])
         m = evaluator.mse(out, tgt)
-        np.testing.assert_allclose(float(m["mse"]), 0.5, rtol=1e-6)
+        np.testing.assert_allclose(float(m["loss"]), 0.5, rtol=1e-6)
         np.testing.assert_allclose(float(m["max_diff"]), 1.0, rtol=1e-6)
         m2 = evaluator.mse(out, tgt, mask=jnp.array([0.0, 1.0]))
-        np.testing.assert_allclose(float(m2["mse"]), 0.0, atol=1e-7)
+        np.testing.assert_allclose(float(m2["loss"]), 0.0, atol=1e-7)
+
+    def test_epoch_extras_aggregation(self):
+        # mean-style extras average sample-weighted; max_* keep the peak
+        d = decision.Decision(max_epochs=5)
+        d.add_minibatch(
+            "train",
+            {"n_samples": 10, "loss": 1.0, "some_metric": 2.0, "max_diff": 5.0},
+        )
+        d.add_minibatch(
+            "train",
+            {"n_samples": 30, "loss": 1.0, "some_metric": 6.0, "max_diff": 3.0},
+        )
+        s = d.on_epoch_end()["summary"]["train"]
+        np.testing.assert_allclose(s["some_metric"], 5.0)  # (2*10+6*30)/40
+        np.testing.assert_allclose(s["max_diff"], 5.0)
 
 
 class TestDecision:

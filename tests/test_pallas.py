@@ -1,0 +1,134 @@
+"""Pallas kernels vs their jnp reference twins.
+
+The rebuild of the reference's numpy-vs-OpenCL-vs-CUDA golden tests
+(SURVEY.md §4): every Pallas kernel must match the pure-jnp implementation,
+including gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.ops import kohonen as kh, normalization
+from znicz_tpu.ops.pallas import kohonen as pallas_kh
+
+
+class TestPallasLRN:
+    def _x(self, shape=(2, 7, 7, 96), seed=0):
+        return jax.random.normal(jax.random.key(seed), shape, jnp.float32)
+
+    def test_forward_matches_xla(self):
+        x = self._x()
+        y_ref = normalization.lrn(x, impl="xla")
+        y_pal = normalization.lrn(x, impl="pallas")
+        np.testing.assert_allclose(y_pal, y_ref, rtol=1e-5, atol=1e-6)
+
+    def test_forward_nondefault_params(self):
+        x = self._x((4, 3, 3, 64), seed=1)
+        kw = dict(alpha=2e-4, beta=0.5, k=1.0, n=3)
+        np.testing.assert_allclose(
+            normalization.lrn(x, impl="pallas", **kw),
+            normalization.lrn(x, impl="xla", **kw),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_gradient_matches_xla(self):
+        x = self._x((2, 5, 5, 32), seed=2)
+
+        def loss(impl):
+            return lambda x: jnp.sum(
+                jnp.sin(normalization.lrn(x, impl=impl))
+            )
+
+        g_ref = jax.grad(loss("xla"))(x)
+        g_pal = jax.grad(loss("pallas"))(x)
+        np.testing.assert_allclose(g_pal, g_ref, rtol=1e-4, atol=1e-5)
+
+    def test_gradient_even_window(self):
+        # even n: the backward window is the TRANSPOSED extent of forward
+        x = self._x((2, 4, 4, 32), seed=6)
+        kw = dict(alpha=1e-3, beta=0.6, k=1.5, n=4)
+
+        def loss(impl):
+            return lambda x: jnp.sum(
+                jnp.cos(normalization.lrn(x, impl=impl, **kw))
+            )
+
+        g_ref = jax.grad(loss("xla"))(x)
+        g_pal = jax.grad(loss("pallas"))(x)
+        np.testing.assert_allclose(g_pal, g_ref, rtol=1e-4, atol=1e-5)
+
+    def test_rows_not_multiple_of_tile(self):
+        # 2*3*3 = 18 rows << ROW_TILE: exercises the padded last block
+        x = self._x((2, 3, 3, 128), seed=3)
+        np.testing.assert_allclose(
+            normalization.lrn(x, impl="pallas"),
+            normalization.lrn(x, impl="xla"),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_under_jit_and_bf16(self):
+        x = self._x((2, 4, 4, 96)).astype(jnp.bfloat16)
+        f = jax.jit(lambda x: normalization.lrn(x, impl="pallas"))
+        y = f(x)
+        assert y.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            y.astype(jnp.float32),
+            normalization.lrn(
+                x.astype(jnp.float32), impl="xla"
+            ),
+            rtol=2e-2,
+            atol=2e-2,
+        )
+
+
+class TestPallasKohonen:
+    def _setup(self, b=100, sx=6, sy=6, f=784, seed=0):
+        k1, k2 = jax.random.split(jax.random.key(seed))
+        params = {
+            "weights": jax.random.normal(k1, (sx * sy, f), jnp.float32) * 0.1
+        }
+        x = jax.random.normal(k2, (b, f), jnp.float32)
+        coords = kh.grid_coords(sx, sy)
+        return params, x, coords
+
+    def test_matches_jnp_twin(self):
+        params, x, coords = self._setup()
+        ref, _ = kh.train_step(
+            params, x, coords, learning_rate=0.5, sigma=1.5
+        )
+        fused = pallas_kh.train_step(
+            params, x, coords, learning_rate=0.5, sigma=1.5
+        )
+        np.testing.assert_allclose(
+            fused["weights"], ref["weights"], rtol=1e-4, atol=1e-5
+        )
+
+    def test_mask_and_multi_tile(self):
+        # batch > BATCH_TILE exercises scratch accumulation across grid steps
+        params, x, coords = self._setup(b=600, f=256, seed=3)
+        mask = (jnp.arange(600) < 500).astype(jnp.float32)
+        ref, _ = kh.train_step(
+            params, x, coords, learning_rate=0.3, sigma=2.0, mask=mask
+        )
+        fused = pallas_kh.train_step(
+            params, x, coords, learning_rate=0.3, sigma=2.0, mask=mask
+        )
+        np.testing.assert_allclose(
+            fused["weights"], ref["weights"], rtol=1e-4, atol=1e-5
+        )
+
+    def test_padded_batch(self):
+        # b not a multiple of BATCH_TILE -> host-side zero-mask padding
+        params, x, coords = self._setup(b=300, f=64, seed=5)
+        ref, _ = kh.train_step(
+            params, x, coords, learning_rate=0.2, sigma=1.0
+        )
+        fused = pallas_kh.train_step(
+            params, x, coords, learning_rate=0.2, sigma=1.0
+        )
+        np.testing.assert_allclose(
+            fused["weights"], ref["weights"], rtol=1e-4, atol=1e-5
+        )

@@ -1,0 +1,115 @@
+"""Ensemble training: N model instances, aggregated evaluation.
+
+Capability parity with ``veles/ensemble/`` [SURVEY.md 2.1 "Ensembles"]: the
+reference trains N instances of a workflow (process-level task parallelism)
+and aggregates their evaluation.  Here instances train sequentially in-process
+(each gets its own derived seed) and predictions aggregate by mean probability
+or majority vote.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.logger import Logger
+
+
+class Ensemble(Logger):
+    """Train ``n_models`` workflows built by ``build_fn()`` and aggregate.
+
+    ``build_fn``: zero-arg callable returning a fresh (un-initialized)
+    workflow with a ``model`` attribute (StandardWorkflow-style).
+    """
+
+    def __init__(
+        self,
+        build_fn: Callable[[], object],
+        n_models: int = 5,
+        *,
+        base_seed: int = 1234,
+    ):
+        self.build_fn = build_fn
+        self.n_models = n_models
+        self.base_seed = base_seed
+        self.workflows: List[object] = []
+        self.decisions: List[object] = []
+
+    def train(self, seeds: Optional[Sequence[int]] = None) -> List[object]:
+        seeds = list(seeds) if seeds else [
+            self.base_seed + 1000 * i for i in range(self.n_models)
+        ]
+        self.workflows, self.decisions = [], []
+        for i, seed in enumerate(seeds):
+            prng.seed_all(seed)
+            wf = self.build_fn()
+            wf.initialize(seed=seed)
+            dec = wf.run()
+            self.info(
+                "member %d/%d (seed %d): best=%s",
+                i + 1, len(seeds), seed, dec.best_value,
+            )
+            self.workflows.append(wf)
+            self.decisions.append(dec)
+        return self.decisions
+
+    # -- aggregation -------------------------------------------------------
+    def predict_proba(self, x) -> jnp.ndarray:
+        """Mean class probability over members (softmax-headed models)."""
+        if not self.workflows:
+            raise RuntimeError("train() first")
+        probs = [
+            wf.model.predict(wf.state.params, jnp.asarray(x))
+            for wf in self.workflows
+        ]
+        return jnp.mean(jnp.stack(probs), axis=0)
+
+    def predict(self, x, *, vote: str = "soft") -> np.ndarray:
+        """``soft``: argmax of mean probs; ``hard``: majority vote."""
+        if vote == "soft":
+            return np.asarray(jnp.argmax(self.predict_proba(x), axis=1))
+        votes = np.stack(
+            [
+                np.asarray(
+                    jnp.argmax(
+                        wf.model.predict(wf.state.params, jnp.asarray(x)),
+                        axis=1,
+                    )
+                )
+                for wf in self.workflows
+            ]
+        )  # [n_models, batch]
+        n_classes = int(votes.max()) + 1
+        counts = np.apply_along_axis(
+            lambda col: np.bincount(col, minlength=n_classes), 0, votes
+        )
+        return counts.argmax(axis=0)
+
+    def evaluate(self, split: str = "test") -> dict:
+        """Aggregate error rate of the ensemble vs. the mean member."""
+        loader = self.workflows[0].loader
+        n_err, n, member_errs = 0, 0, np.zeros(len(self.workflows))
+        for mb in loader.batches(split):
+            valid = mb.mask > 0
+            pred = self.predict(mb.data)[valid]
+            labels = mb.labels[valid]
+            n_err += int((pred != labels).sum())
+            n += int(valid.sum())
+            for i, wf in enumerate(self.workflows):
+                p = np.asarray(
+                    jnp.argmax(
+                        wf.model.predict(wf.state.params, jnp.asarray(mb.data)),
+                        axis=1,
+                    )
+                )[valid]
+                member_errs[i] += (p != labels).sum()
+        return {
+            "n_samples": n,
+            "ensemble_err_pct": 100.0 * n_err / max(n, 1),
+            "mean_member_err_pct": float(
+                100.0 * member_errs.mean() / max(n, 1)
+            ),
+        }

@@ -1,0 +1,97 @@
+"""Model export for native (C++) deployment.
+
+The reference ships ``libVeles``/``libZnicz`` — C++ engines that load trained
+snapshots and run forward passes without Python [SURVEY.md 2.1 "libVeles",
+2.3 "libZnicz", 2.4].  The rebuild's equivalent: export a trained model to a
+self-describing binary file that ``native/znicz_infer`` (C++) executes on CPU
+for deployment.
+
+Format (little-endian):
+    8 bytes   magic  "ZNICZT01"
+    4 bytes   uint32 header_len
+    N bytes   JSON header: {"input_shape": [...], "layers": [
+                  {"type": ..., "config": {...},
+                   "params": {"weights": {"shape": [...], "offset": B,
+                              "size": n_floats}, ...}}]}
+    ...       float32 parameter blobs at the stated byte offsets
+              (relative to the end of the header)
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict
+
+import numpy as np
+
+MAGIC = b"ZNICZT01"
+
+# forward-config keys the native engine understands, per layer type
+_CONFIG_KEYS = (
+    "kx", "ky", "sliding", "padding", "n_kernels", "n_channels",
+    "alpha", "beta", "k", "n", "output_sample_shape", "n_output",
+    "include_bias", "dropout_ratio",
+)
+
+
+def export_model(model, path: str) -> Dict[str, Any]:
+    """Write ``model`` (workflow.model.Model) to ``path``; returns header."""
+    layers = []
+    blobs = []
+    offset = 0
+    for spec, params in zip(model.layer_specs, model.params):
+        if isinstance(spec.get("padding"), str):
+            raise ValueError(
+                f"layer {spec['type']!r} uses padding={spec['padding']!r}; "
+                "native export needs explicit (left, top, right, bottom) "
+                "padding — string padding depends on input size"
+            )
+        config = {
+            key: _jsonable(spec[key]) for key in _CONFIG_KEYS if key in spec
+        }
+        entry: Dict[str, Any] = {
+            "type": spec["type"],
+            "config": config,
+            "params": {},
+        }
+        for name, value in params.items():
+            arr = np.ascontiguousarray(np.asarray(value, np.float32))
+            entry["params"][name] = {
+                "shape": list(arr.shape),
+                "offset": offset,
+                "size": int(arr.size),
+            }
+            blobs.append(arr)
+            offset += arr.nbytes
+        layers.append(entry)
+    header = {
+        "format": 1,
+        "input_shape": list(model.input_shape),
+        "output_shape": list(model.output_shape),
+        # The ENGINE's output semantics, not the python model's: znicz_infer
+        # applies softmax for a softmax head, so a softmax-headed model
+        # (returns_logits in python) emits probabilities from the artifact.
+        "output_kind": (
+            "probabilities" if model.returns_logits else "raw"
+        ),
+        "layers": layers,
+    }
+    payload = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", len(payload)))
+        f.write(payload)
+        for blob in blobs:
+            f.write(blob.tobytes())
+    return header
+
+
+def _jsonable(v):
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v

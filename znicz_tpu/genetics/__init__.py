@@ -135,9 +135,19 @@ class GeneticOptimizer(Logger):
             self._random_genome() for _ in range(self.population_size - 1)
         ]
         best = None
+        fitness_cache: Dict[tuple, float] = {}
+
+        def fitness(genome: List[float]) -> float:
+            # an evaluation is a full training run: never re-train elites
+            # or duplicate children
+            key = tuple(genome)
+            if key not in fitness_cache:
+                fitness_cache[key] = self.evaluate(genome)
+            return fitness_cache[key]
+
         for g in range(generations):
             scored = sorted(
-                (self.evaluate(genome), genome) for genome in population
+                (fitness(genome), genome) for genome in population
             )
             if best is None or scored[0][0] < best[0]:
                 best = scored[0]

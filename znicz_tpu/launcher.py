@@ -119,8 +119,10 @@ def run_args(argv=None) -> Launcher:
         # at this point).
         import jax
 
+        # "tpu,axon": force an accelerator — either the native TPU plugin or
+        # a relay-registered one; errors out rather than silently using CPU.
         jax.config.update(
-            "jax_platforms", "cpu" if args.device == "cpu" else None
+            "jax_platforms", "cpu" if args.device == "cpu" else "tpu,axon"
         )
     launcher = Launcher(args)
     sys.path.insert(0, os.path.dirname(os.path.abspath(args.workflow)))

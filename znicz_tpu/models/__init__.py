@@ -22,8 +22,32 @@ def effective_config(node, defaults: dict):
 
     cfg = Config(getattr(node, "_config_path_", ""))
     cfg.update(copy.deepcopy(defaults))
-    cfg.update(node.to_dict())
+    # deep-copy the overrides too: to_dict() returns lists/dicts by
+    # reference, and model builders mutate the merged config (layer shapes),
+    # which must never write through into root or module DEFAULTS
+    cfg.update(copy.deepcopy(node.to_dict()))
     return cfg
+
+
+def translate_unsupervised_overrides(kwargs: dict, epochs_key: str) -> dict:
+    """Map launcher-style overrides (snapshot_dir, decision_config) onto the
+    unsupervised workflow APIs (Kohonen/RBM), which take a Snapshotter
+    instance and a direct epochs kwarg instead."""
+    kwargs = dict(kwargs)
+    snapshot_dir = kwargs.pop("snapshot_dir", None)
+    if snapshot_dir:
+        from znicz_tpu.workflow import Snapshotter
+
+        kwargs["snapshotter"] = Snapshotter(snapshot_dir, kwargs["name"])
+    dc = kwargs.pop("decision_config", None)
+    if dc:
+        if "max_epochs" in dc:
+            kwargs[epochs_key] = dc["max_epochs"]
+        # honor the remaining Decision knobs (fail_iterations, ...) too
+        from znicz_tpu.nn.decision import Decision
+
+        kwargs.setdefault("decision", Decision(metric="loss", **dc))
+    return kwargs
 
 
 def merge_workflow_kwargs(base: dict, overrides: dict) -> dict:

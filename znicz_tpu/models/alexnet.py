@@ -82,6 +82,8 @@ DEFAULTS = {
     ],
     "decision": {"max_epochs": 90, "fail_iterations": 30},
     "lr_policy": {"name": "step", "step_size": 100000, "gamma": 0.1},
+    # bf16 activations halve HBM traffic; accumulation stays f32 (model.py)
+    "compute_dtype": "bfloat16",
 }
 root.alexnet.update(DEFAULTS)
 
@@ -100,6 +102,7 @@ def build_workflow(**overrides) -> StandardWorkflow:
         {
             "decision_config": cfg.decision.to_dict(),
             "lr_policy": cfg.get("lr_policy"),
+            "compute_dtype": cfg.get("compute_dtype"),
             "name": "AlexNetWorkflow",
         },
         overrides,

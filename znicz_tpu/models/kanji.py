@@ -1,0 +1,59 @@
+"""Kanji classifier sample.
+
+Parity with ``znicz/samples/Kanji`` [SURVEY.md 2.3 "Samples"]: a deeper MLP
+classifying handwritten-kanji-style images (large class count relative to
+MNIST).  Real data dir may be supplied; otherwise a deterministic synthetic
+stand-in with the same geometry is generated.
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+_GD = {"learning_rate": 0.02, "gradient_moment": 0.9, "weights_decay": 0.0005}
+
+DEFAULTS = {
+    "loader": {
+        "minibatch_size": 50,
+        "n_train": 1500,
+        "n_test": 300,
+        "n_classes": 24,
+        "side": 24,
+    },
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 250}, "<-": _GD},
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 100}, "<-": _GD},
+        {"type": "softmax", "->": {"output_sample_shape": 24}, "<-": _GD},
+    ],
+    "decision": {"max_epochs": 15, "fail_iterations": 20},
+}
+root.kanji.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.kanji, DEFAULTS)
+    lcfg = cfg.loader
+    side = lcfg.get("side", 24)
+    n_classes = lcfg.get("n_classes", 24)
+    data, labels = datasets._synthetic_split(
+        lcfg.get("n_train", 1500), lcfg.get("n_test", 300),
+        (side * side,), n_classes,
+    )
+    from znicz_tpu.loader import FullBatchLoader
+
+    loader = FullBatchLoader(
+        data, labels, minibatch_size=lcfg.get("minibatch_size", 50)
+    )
+    layers = cfg.get("layers")
+    layers[-1]["->"]["output_sample_shape"] = n_classes
+    kwargs = merge_workflow_kwargs(
+        {"decision_config": cfg.decision.to_dict(), "name": "KanjiWorkflow"},
+        overrides,
+    )
+    return StandardWorkflow(loader, layers, **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

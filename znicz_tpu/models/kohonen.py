@@ -6,7 +6,11 @@ BASELINE.json configs[4]]: unsupervised SOM training on MNIST-shaped data.
 
 from znicz_tpu.core.config import root
 from znicz_tpu.loader import datasets
-from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.models import (
+    effective_config,
+    merge_workflow_kwargs,
+    translate_unsupervised_overrides,
+)
 from znicz_tpu.workflow import KohonenWorkflow
 
 DEFAULTS = {
@@ -48,15 +52,7 @@ def build_workflow(**overrides) -> KohonenWorkflow:
         },
         overrides,
     )
-    # translate launcher-style overrides for the unsupervised workflow API
-    snapshot_dir = kwargs.pop("snapshot_dir", None)
-    if snapshot_dir:
-        from znicz_tpu.workflow import Snapshotter
-
-        kwargs["snapshotter"] = Snapshotter(snapshot_dir, kwargs["name"])
-    dc = kwargs.pop("decision_config", None)
-    if dc and "max_epochs" in dc:
-        kwargs["total_epochs"] = dc["max_epochs"]
+    kwargs = translate_unsupervised_overrides(kwargs, "total_epochs")
     return KohonenWorkflow(loader, **kwargs)
 
 

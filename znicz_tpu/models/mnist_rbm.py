@@ -6,7 +6,11 @@ Parity with ``znicz/samples/MNIST`` RBM workflow (``mnist_rbm.py``)
 
 from znicz_tpu.core.config import root
 from znicz_tpu.loader import datasets
-from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.models import (
+    effective_config,
+    merge_workflow_kwargs,
+    translate_unsupervised_overrides,
+)
 from znicz_tpu.workflow import RBMWorkflow
 
 DEFAULTS = {
@@ -48,14 +52,7 @@ def build_workflow(**overrides) -> RBMWorkflow:
         },
         overrides,
     )
-    snapshot_dir = kwargs.pop("snapshot_dir", None)
-    if snapshot_dir:
-        from znicz_tpu.workflow import Snapshotter
-
-        kwargs["snapshotter"] = Snapshotter(snapshot_dir, kwargs["name"])
-    dc = kwargs.pop("decision_config", None)
-    if dc and "max_epochs" in dc:
-        kwargs["max_epochs"] = dc["max_epochs"]
+    kwargs = translate_unsupervised_overrides(kwargs, "max_epochs")
     return RBMWorkflow(loader, **kwargs)
 
 

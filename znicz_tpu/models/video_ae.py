@@ -1,0 +1,78 @@
+"""Video autoencoder sample.
+
+Parity with ``znicz/samples/VideoAE`` [SURVEY.md 2.3 "Samples"]: an
+autoencoder over video frames (flattened grayscale frames, MSE against the
+input).  Synthetic stand-in generates smooth frame sequences (per-class
+prototype + temporal drift) with the same shapes.
+"""
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import FullBatchLoader
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+_GD = {"learning_rate": 0.05, "gradient_moment": 0.9}
+
+DEFAULTS = {
+    "loader": {
+        "minibatch_size": 50,
+        "n_sequences": 20,
+        "frames_per_seq": 30,
+        "side": 16,
+    },
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 64}, "<-": _GD},
+        {"type": "all2all", "->": {"output_sample_shape": 256}, "<-": _GD},
+    ],
+    "decision": {"max_epochs": 15, "fail_iterations": 15},
+}
+root.video_ae.update(DEFAULTS)
+
+
+def _synthetic_frames(n_seq: int, frames: int, side: int) -> np.ndarray:
+    """Smoothly drifting frame sequences (what makes video-AE video-like)."""
+    gen = prng.get("datasets")
+    dim = side * side
+    out = np.zeros((n_seq * frames, dim), np.float32)
+    for s in range(n_seq):
+        base = gen.normal((dim,), 0.0, 1.0)
+        drift = gen.normal((dim,), 0.0, 0.05)
+        for t in range(frames):
+            noise = gen.normal((dim,), 0.0, 0.1)
+            out[s * frames + t] = base + t * drift + noise
+    return out
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.video_ae, DEFAULTS)
+    lcfg = cfg.loader
+    side = lcfg.get("side", 16)
+    frames = _synthetic_frames(
+        lcfg.get("n_sequences", 20), lcfg.get("frames_per_seq", 30), side
+    )
+    n_test = len(frames) // 5
+    loader = FullBatchLoader(
+        {"train": frames[n_test:], "test": frames[:n_test]},
+        minibatch_size=lcfg.get("minibatch_size", 50),
+        normalization="mean_disp",
+    )
+    layers = cfg.get("layers")
+    layers[-1]["->"]["output_sample_shape"] = side * side
+    kwargs = merge_workflow_kwargs(
+        {
+            "decision_config": cfg.decision.to_dict(),
+            "loss_function": "mse",
+            "target": "input",
+            "name": "VideoAEWorkflow",
+        },
+        overrides,
+    )
+    return StandardWorkflow(loader, layers, **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

@@ -1,0 +1,60 @@
+"""Yale Faces classifier sample.
+
+Parity with ``znicz/samples/YaleFaces`` [SURVEY.md 2.3 "Samples"]: small
+face-identity classifier (few classes, few samples per class, larger images
+than MNIST).  Synthetic stand-in keeps the geometry when no data dir exists.
+"""
+
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import FullBatchLoader, datasets
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow import StandardWorkflow
+
+_GD = {"learning_rate": 0.01, "gradient_moment": 0.9, "weights_decay": 0.0005}
+
+DEFAULTS = {
+    "loader": {
+        "minibatch_size": 20,
+        "n_train": 480,
+        "n_test": 96,
+        "n_classes": 15,  # Yale has 15 subjects
+        "side": 32,
+    },
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 100}, "<-": _GD},
+        {"type": "softmax", "->": {"output_sample_shape": 15}, "<-": _GD},
+    ],
+    "decision": {"max_epochs": 20, "fail_iterations": 20},
+}
+root.yale_faces.update(DEFAULTS)
+
+
+def build_workflow(**overrides) -> StandardWorkflow:
+    cfg = effective_config(root.yale_faces, DEFAULTS)
+    lcfg = cfg.loader
+    side = lcfg.get("side", 32)
+    n_classes = lcfg.get("n_classes", 15)
+    data, labels = datasets._synthetic_split(
+        lcfg.get("n_train", 480), lcfg.get("n_test", 96),
+        (side * side,), n_classes,
+    )
+    loader = FullBatchLoader(
+        data, labels,
+        minibatch_size=lcfg.get("minibatch_size", 20),
+        normalization="mean_disp",
+    )
+    layers = cfg.get("layers")
+    layers[-1]["->"]["output_sample_shape"] = n_classes
+    kwargs = merge_workflow_kwargs(
+        {
+            "decision_config": cfg.decision.to_dict(),
+            "name": "YaleFacesWorkflow",
+        },
+        overrides,
+    )
+    return StandardWorkflow(loader, layers, **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

@@ -37,13 +37,23 @@ class EpochMetrics:
             if k in ("n_samples", "n_err", "loss"):
                 continue
             try:
-                self.extras[k] = max(self.extras.get(k, float("-inf")), float(v))
+                v = float(v)
             except (TypeError, ValueError):
-                pass  # non-scalar extras (confusion matrix) are not reduced
+                continue  # non-scalar extras (confusion matrix) not reduced
+            if k.startswith("max_"):  # peak-style metrics keep the max
+                self.extras[k] = max(self.extras.get(k, float("-inf")), v)
+            else:  # everything else is a sample-weighted epoch mean
+                self.extras[k] = self.extras.get(k, 0.0) + v * n
 
     @property
     def loss(self) -> float:
         return self.loss_sum / max(self.n_samples, 1.0)
+
+    def extras_summary(self) -> Dict[str, float]:
+        return {
+            k: v if k.startswith("max_") else v / max(self.n_samples, 1.0)
+            for k, v in self.extras.items()
+        }
 
     @property
     def err_pct(self) -> float:
@@ -94,7 +104,7 @@ class Decision:
                 "n_err": m.n_err,
                 "err_pct": m.err_pct,
                 "loss": m.loss,
-                **m.extras,
+                **m.extras_summary(),
             }
             for split, m in self._current.items()
         }

@@ -73,23 +73,21 @@ def mse(
     target: jnp.ndarray,
     *,
     mask: Optional[jnp.ndarray] = None,
-    root: bool = True,
 ) -> Dict[str, jnp.ndarray]:
     """Mean-squared-error evaluator (EvaluatorMSE).
 
-    Returns ``loss`` (mean over valid samples of per-sample mean square),
-    ``mse`` (same), ``max_diff``, and ``rmse`` when ``root``.
+    Returns ``loss`` (mean over valid samples of per-sample mean square)
+    and ``max_diff`` (largest absolute elementwise error).
     """
     mask, n_valid = _norm_mask(mask, output.shape[0])
     diff = (output - target).reshape(output.shape[0], -1)
     per_sample = jnp.mean(jnp.square(diff), axis=1)
     loss = jnp.sum(per_sample * mask) / n_valid
-    out = {
+    # "loss" IS the mse; no duplicate key, so epoch aggregation (mean of
+    # loss, max of max_*) can't disagree with itself.  rmse is derived by
+    # consumers as sqrt(loss) at epoch granularity.
+    return {
         "loss": loss,
-        "mse": loss,
         "max_diff": jnp.max(jnp.max(jnp.abs(diff), axis=1) * mask),
         "n_samples": n_valid,
     }
-    if root:
-        out["rmse"] = jnp.sqrt(loss)
-    return out

@@ -11,6 +11,7 @@ hot ops additionally get Pallas TPU kernels under ``znicz_tpu/ops/pallas/``,
 cross-checked against the jnp versions in tests (SURVEY.md section 4).
 """
 
+from znicz_tpu.ops import accumulator  # noqa: F401
 from znicz_tpu.ops import activation  # noqa: F401
 from znicz_tpu.ops import all2all  # noqa: F401
 from znicz_tpu.ops import conv  # noqa: F401
@@ -21,3 +22,5 @@ from znicz_tpu.ops import kohonen  # noqa: F401
 from znicz_tpu.ops import normalization  # noqa: F401
 from znicz_tpu.ops import pooling  # noqa: F401
 from znicz_tpu.ops import rbm  # noqa: F401
+from znicz_tpu.ops import resizable_all2all  # noqa: F401
+from znicz_tpu.ops import weights_zerofilling  # noqa: F401

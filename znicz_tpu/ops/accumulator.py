@@ -1,0 +1,51 @@
+"""Streaming range/moment accumulator.
+
+Capability parity with ``znicz/accumulator.py`` [SURVEY.md 2.2 "Weight/bias
+accumulation utils"]: accumulate min/max/mean statistics of a tensor stream
+(activation ranges across minibatches — the reference uses this for
+fixed-point deployment analysis).  Pure functional: ``init`` -> ``update``
+per batch -> read the fields.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class RangeStats(NamedTuple):
+    lo: jnp.ndarray  # per-feature min
+    hi: jnp.ndarray  # per-feature max
+    total: jnp.ndarray  # per-feature sum
+    count: jnp.ndarray  # scalar number of samples
+
+    @property
+    def mean(self):
+        return self.total / jnp.maximum(self.count, 1.0)
+
+
+def init(n_features: int, dtype=jnp.float32) -> RangeStats:
+    return RangeStats(
+        lo=jnp.full((n_features,), jnp.inf, dtype),
+        hi=jnp.full((n_features,), -jnp.inf, dtype),
+        total=jnp.zeros((n_features,), dtype),
+        count=jnp.zeros((), dtype),
+    )
+
+
+def update(stats: RangeStats, x: jnp.ndarray, mask=None) -> RangeStats:
+    """Fold a [batch, features] tensor into the stats (mask optional)."""
+    x = x.reshape(x.shape[0], -1)
+    if mask is None:
+        valid = jnp.ones((x.shape[0],), x.dtype)
+    else:
+        valid = mask.astype(x.dtype)
+    big = jnp.where(valid[:, None] > 0, x, jnp.inf)
+    small = jnp.where(valid[:, None] > 0, x, -jnp.inf)
+    return RangeStats(
+        lo=jnp.minimum(stats.lo, jnp.min(big, axis=0)),
+        hi=jnp.maximum(stats.hi, jnp.max(small, axis=0)),
+        total=stats.total + jnp.sum(x * valid[:, None], axis=0),
+        count=stats.count + jnp.sum(valid),
+    )

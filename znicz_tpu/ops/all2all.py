@@ -58,10 +58,13 @@ def apply(
     """Forward: flatten trailing dims, matmul on the MXU, apply activation."""
     n_in = params["weights"].shape[0]
     x = x.reshape(x.shape[0], n_in)
-    y = jnp.dot(x, params["weights"], preferred_element_type=jnp.float32)
+    # f32 inputs accumulate in f32 on the MXU; bf16 inputs emit bf16 (XLA
+    # accumulates f32 internally) so activations cost half the HBM traffic
+    pref = jnp.float32 if x.dtype == jnp.float32 else None
+    y = jnp.dot(x, params["weights"], preferred_element_type=pref)
     if include_bias:
         y = y + params["bias"]
-    return act.get(activation)(y)
+    return act.get(activation)(y).astype(x.dtype)
 
 
 def softmax_apply(

@@ -1,0 +1,97 @@
+"""Scaled dot-product / multi-head attention.
+
+NOT in the reference — VELES/Znicz predates transformers (SURVEY.md 5.7) —
+but the rebuild treats long-context as first-class: this is the single-device
+reference implementation that :mod:`znicz_tpu.parallel.ring_attention`
+shards over the mesh's sequence axis.
+
+Layouts: ``q/k/v`` are ``[batch, seq, heads, head_dim]`` (BTHD).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.ops.filling import fill
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Stable softmax attention; returns [B, Tq, H, D]."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def init_mha_params(
+    d_model: int,
+    n_heads: int,
+    *,
+    head_dim: Optional[int] = None,
+    weights_stddev: Optional[float] = None,
+    weights_filling: str = "gaussian",
+    rand_name: str = "default",
+    dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    gen = prng.get(rand_name)
+    head_dim = head_dim or d_model // n_heads
+    if weights_stddev is None:
+        weights_stddev = 1.0 / np.sqrt(d_model)
+    inner = n_heads * head_dim
+    params = {}
+    for name in ("wq", "wk", "wv"):
+        params[name] = jnp.asarray(
+            fill(gen, (d_model, inner), weights_filling, weights_stddev), dtype
+        )
+    params["wo"] = jnp.asarray(
+        fill(gen, (inner, d_model), weights_filling, weights_stddev), dtype
+    )
+    return params
+
+
+def mha(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,  # [B, T, d_model]
+    *,
+    n_heads: int,
+    causal: bool = False,
+    attention_fn=dot_product_attention,
+) -> jnp.ndarray:
+    """Multi-head self-attention block (projections + attention + output).
+
+    ``attention_fn`` is pluggable so the ring-parallel variant drops in.
+    """
+    b, t, _ = x.shape
+    def proj(w):
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+        return y.reshape(b, t, n_heads, -1)
+
+    q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+    o = attention_fn(q, k, v, causal=causal)
+    o = o.reshape(b, t, -1)
+    return jnp.dot(o, params["wo"], preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )

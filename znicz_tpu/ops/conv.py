@@ -71,16 +71,20 @@ def apply(
     """Forward conv, NHWC.  ``sliding`` is (sx, sy) per the reference."""
     pad = _norm_padding(padding)
     strides = (sliding[1], sliding[0])  # (sy, sx) -> spatial order (H, W)
+    # bf16 inputs: emit bf16 (XLA still accumulates f32 on the TPU MXU);
+    # requesting an f32 output here would put an astype on the transpose
+    # path and break the conv gradient's dtype matching.
+    pref = jnp.float32 if x.dtype == jnp.float32 else None
     y = lax.conv_general_dilated(
         x,
         params["weights"],
         window_strides=strides,
         padding=pad,
         dimension_numbers=DIMENSION_NUMBERS,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=pref,
     )
     y = y + params["bias"]
-    return act.get(activation)(y)
+    return act.get(activation)(y).astype(x.dtype)
 
 
 def output_shape(

@@ -96,9 +96,11 @@ def apply(
         padding=(pad_h, pad_w),
         lhs_dilation=(sy, sx),
         dimension_numbers=conv_op.DIMENSION_NUMBERS,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=(
+            jnp.float32 if x.dtype == jnp.float32 else None
+        ),
     )
-    return act.get(activation)(y)
+    return act.get(activation)(y).astype(x.dtype)
 
 
 def depool_with_offset(

@@ -43,6 +43,21 @@ def lrn(
     beta: float = DEFAULT_BETA,
     k: float = DEFAULT_K,
     n: int = DEFAULT_N,
+    impl: str = "xla",
 ) -> jnp.ndarray:
+    """LRN dispatch.
+
+    ``impl="xla"`` (default): the reduce_window composition — XLA fuses it
+    into neighboring conv/elementwise ops and this measured FASTER than the
+    hand kernel inside AlexNet training (11.6k vs 9.0k images/sec on one
+    v5e chip, bench.py), because a pallas_call is a fusion barrier.
+    ``impl="pallas"``: the fused VMEM kernel (znicz_tpu/ops/pallas/lrn.py),
+    kept as the hand-written-kernel path (reference ocl/cuda analog) and for
+    standalone LRN-heavy uses where no surrounding fusion exists.
+    """
+    if impl == "pallas":
+        from znicz_tpu.ops.pallas import lrn as pallas_lrn
+
+        return pallas_lrn.lrn(x, alpha, beta, k, n)
     sums = _window_sums(jnp.square(x), n)
     return x * jnp.power(k + alpha * sums, -beta)

@@ -1,0 +1,127 @@
+"""Fused Kohonen batch-SOM update kernel.
+
+TPU-native equivalent of the reference's ``kohonen.cl/.cu`` winner-take-all +
+neighborhood-update kernels [SURVEY.md 2.2 row "Kohonen SOM", 2.4;
+BASELINE.json configs[4] exists to stress exactly this op].  One pallas_call
+fuses what the jnp twin (:func:`znicz_tpu.ops.kohonen.train_step`) does in
+five XLA ops: winner scores (MXU), argmax, neighborhood weights, and the two
+accumulation matmuls — the [B, M] intermediates never leave VMEM.
+
+Grid: batch tiles; num/den accumulate in VMEM scratch across steps and the
+weight update happens once on the last step.  Gathers (coords[win]) are
+expressed as one-hot matmuls — dense beats scatter/gather on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BATCH_TILE = 256
+
+
+def _kernel(
+    x_ref,  # [Bt, F]
+    mask_ref,  # [Bt, 1]
+    w_ref,  # [M, F]
+    d2m_ref,  # [M, M] pairwise squared grid distances (static per map)
+    lr_ref,  # [1, 1] SMEM
+    sigma_ref,  # [1, 1] SMEM
+    out_ref,  # [M, F]
+    num_ref,  # scratch [M, F]
+    den_ref,  # scratch [M, 1]
+):
+    # Everything stays 2-D: Mosaic does not lower 1-D intermediates, so the
+    # winner "gather" is a one-hot matmul against the neighborhood matrix.
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        num_ref[:] = jnp.zeros_like(num_ref)
+        den_ref[:] = jnp.zeros_like(den_ref)
+
+    x = x_ref[:]
+    w = w_ref[:]
+    mask = mask_ref[:]  # [Bt, 1]
+    # winner scores: argmin ||x-w||^2 == argmax (x.w - ||w||^2/2), MXU matmul
+    w_sq = jnp.sum(w * w, axis=1, keepdims=True)  # [M, 1]
+    scores = (
+        jnp.dot(x, w.T, preferred_element_type=jnp.float32) - 0.5 * w_sq.T
+    )  # [Bt, M]
+    win = jnp.argmax(scores, axis=1, keepdims=True)  # [Bt, 1]
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) == win
+    ).astype(jnp.float32)  # [Bt, M]
+    sigma = sigma_ref[0, 0]
+    neigh = jnp.exp(-d2m_ref[:] / (2.0 * sigma * sigma))  # [M, M]
+    # h[b, j] = neigh[win(b), j]: row-select as a matmul, then mask padding
+    h = (
+        jnp.dot(onehot, neigh, preferred_element_type=jnp.float32) * mask
+    )  # [Bt, M]
+    num_ref[:] += jnp.dot(h.T, x, preferred_element_type=jnp.float32)
+    den_ref[:] += jnp.sum(h.T, axis=1, keepdims=True)  # [M, 1]
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        den = den_ref[:]
+        target = num_ref[:] / jnp.maximum(den, 1e-12)
+        lr = lr_ref[0, 0]
+        out_ref[:] = jnp.where(den > 1e-8, w + lr * (target - w), w)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+@partial(jax.jit, static_argnames=())
+def train_step(params, x, coords, *, learning_rate, sigma, mask=None):
+    """Drop-in fused twin of ops.kohonen.train_step (returns only params;
+    winner indices are cheap to recompute via ops.kohonen.winners)."""
+    w = params["weights"]
+    m, f = w.shape
+    b = x.shape[0]
+    if mask is None:
+        mask = jnp.ones((b,), x.dtype)
+    # pad to a whole number of tiles with mask=0 rows: block padding reads
+    # are undefined, so padding must be explicit
+    bt = pl.cdiv(b, BATCH_TILE) * BATCH_TILE
+    if bt != b:
+        x = jnp.pad(x, ((0, bt - b), (0, 0)))
+        mask = jnp.pad(mask, (0, bt - b))
+        b = bt
+    lr = jnp.asarray(learning_rate, jnp.float32).reshape(1, 1)
+    sg = jnp.asarray(sigma, jnp.float32).reshape(1, 1)
+    d2m = jnp.sum(
+        jnp.square(coords[:, None, :] - coords[None, :, :]), axis=-1
+    )  # [M, M]
+    grid = (pl.cdiv(b, BATCH_TILE),)
+    new_w = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((m, f), w.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (BATCH_TILE, f), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (BATCH_TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((m, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (m, f), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((m, f), jnp.float32),
+            pltpu.VMEM((m, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, mask[:, None], w, d2m, lr, sg)
+    return {"weights": new_w}

@@ -1,0 +1,128 @@
+"""Fused LRN Pallas kernel (forward + hand-written backward).
+
+TPU-native equivalent of the reference's ``normalization.cl/.cu`` kernels
+[SURVEY.md 2.2 row "Local response norm", 2.4]: one VMEM pass computes the
+cross-channel windowed sum-of-squares and the normalized output, instead of
+the XLA composition's reduce_window + pow + mul chain; the backward kernel
+fuses both windowed sums of the LRN gradient.
+
+Math (jnp twin in :mod:`znicz_tpu.ops.normalization`):
+    s_c = k + alpha * sum_{|c'-c| <= n/2} x_{c'}^2
+    y_c = x_c * s_c^-beta
+    dx_c = g_c * s_c^-beta
+           - 2 alpha beta x_c * sum_{window} (g x s^(-beta-1))_{c'}
+
+Layout: input viewed as [rows, C] with rows = N*H*W tiled over the grid and
+the full channel axis resident in VMEM (C is 32..384 for every reference
+config — far under the VMEM budget).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_TILE = 512
+
+
+def _window_sum_lanes(
+    v: jnp.ndarray, n: int, *, transpose: bool = False
+) -> jnp.ndarray:
+    """SAME sliding-window sum over the last (channel/lane) axis:
+    out_c = sum_{d=-lo}^{hi} v_{c+d} (edges clipped) with lo = n//2 and
+    hi = n-1-n//2.  ``transpose=True`` swaps the extents — the adjoint window
+    needed by the backward pass (identical for odd n, shifted for even n).
+    n is a small static constant (5 in every reference config), so this
+    unrolls into a handful of vector shifts fused in VMEM."""
+    lo, hi = n // 2, n - 1 - n // 2
+    if transpose:
+        lo, hi = hi, lo
+    c = v.shape[-1]
+    out = v
+    for off in range(1, max(lo, hi) + 1):
+        if off <= hi:  # right neighbors v_{c+off}
+            out = out + jnp.pad(v[:, off:], ((0, 0), (0, off)))
+        if off <= lo:  # left neighbors v_{c-off}
+            out = out + jnp.pad(v[:, : c - off], ((0, 0), (off, 0)))
+    return out
+
+
+def _fwd_kernel(x_ref, y_ref, *, alpha, beta, k, n):
+    x = x_ref[:]
+    s = k + alpha * _window_sum_lanes(x * x, n)
+    y_ref[:] = x * jax.lax.pow(s, jnp.asarray(-beta, s.dtype))
+
+
+def _bwd_kernel(x_ref, g_ref, dx_ref, *, alpha, beta, k, n):
+    # recompute s from x: cheaper than writing an [N,H,W,C] residual in fwd
+    x = x_ref[:]
+    g = g_ref[:]
+    s = k + alpha * _window_sum_lanes(x * x, n)
+    s_negb = jax.lax.pow(s, jnp.asarray(-beta, s.dtype))
+    inner = g * x * s_negb / s  # g x s^(-beta-1)
+    # adjoint of the forward window: transposed extents (matters for even n)
+    dx_ref[:] = g * s_negb - 2.0 * alpha * beta * x * _window_sum_lanes(
+        inner, n, transpose=True
+    )
+
+
+def _rows_view(x):
+    return x.reshape(-1, x.shape[-1])
+
+
+def _grid(rows):
+    return (pl.cdiv(rows, ROW_TILE),)
+
+
+def _row_spec(c):
+    return pl.BlockSpec(
+        (ROW_TILE, c), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+
+
+def _interpret() -> bool:
+    # off-TPU (tests, NumpyDevice-style runs) the kernel runs interpreted
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def lrn(x, alpha=1e-4, beta=0.75, k=2.0, n=5):
+    """Fused-LRN with the same signature semantics as normalization.lrn."""
+    shape = x.shape
+    v = _rows_view(x)
+    rows, c = v.shape
+    y = pl.pallas_call(
+        partial(_fwd_kernel, alpha=alpha, beta=beta, k=k, n=n),
+        out_shape=jax.ShapeDtypeStruct((rows, c), v.dtype),
+        grid=_grid(rows),
+        in_specs=[_row_spec(c)],
+        out_specs=_row_spec(c),
+        interpret=_interpret(),
+    )(v)
+    return y.reshape(shape)
+
+
+def _lrn_fwd(x, alpha, beta, k, n):
+    return lrn(x, alpha, beta, k, n), x
+
+
+def _lrn_bwd(alpha, beta, k, n, x, g):
+    shape = x.shape
+    xv, gv = _rows_view(x), _rows_view(g)
+    rows, c = xv.shape
+    dx = pl.pallas_call(
+        partial(_bwd_kernel, alpha=alpha, beta=beta, k=k, n=n),
+        out_shape=jax.ShapeDtypeStruct((rows, c), xv.dtype),
+        grid=_grid(rows),
+        in_specs=[_row_spec(c)] * 2,
+        out_specs=_row_spec(c),
+        interpret=_interpret(),
+    )(xv, gv)
+    return (dx.reshape(shape),)
+
+
+lrn.defvjp(_lrn_fwd, _lrn_bwd)

@@ -1,0 +1,51 @@
+"""Resizable fully-connected layer.
+
+Capability parity with ``znicz/resizable_all2all.py`` [SURVEY.md 2.2]: an FC
+layer whose output width can change during an experiment (the reference grows
+or shrinks the unit count and preserves trained weights).  Functionally:
+``resize`` returns a new param dict keeping the overlapping slice and
+initializing any new columns from the shared named PRNG.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.ops import all2all
+from znicz_tpu.ops.filling import fill
+
+apply = all2all.apply  # forward is the ordinary FC
+init_params = all2all.init_params
+
+
+def resize(
+    params: Dict[str, jnp.ndarray],
+    n_output: int,
+    *,
+    weights_stddev: float | None = None,
+    weights_filling: str = "uniform",
+    rand_name: str = "default",
+) -> Dict[str, jnp.ndarray]:
+    """Grow/shrink the output dim, preserving the trained overlap."""
+    w = params["weights"]
+    b = params["bias"]
+    n_in, n_old = w.shape
+    if n_output == n_old:
+        return params
+    if n_output < n_old:
+        return {"weights": w[:, :n_output], "bias": b[:n_output]}
+    gen = prng.get(rand_name)
+    if weights_stddev is None:
+        weights_stddev = 1.0 / np.sqrt(n_in)
+    extra_w = fill(
+        gen, (n_in, n_output - n_old), weights_filling, weights_stddev
+    )
+    extra_b = fill(gen, (n_output - n_old,), weights_filling, weights_stddev)
+    return {
+        "weights": jnp.concatenate([w, jnp.asarray(extra_w, w.dtype)], axis=1),
+        "bias": jnp.concatenate([b, jnp.asarray(extra_b, b.dtype)]),
+    }

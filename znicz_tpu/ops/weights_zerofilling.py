@@ -1,0 +1,57 @@
+"""Structured weight masking (zero-filling).
+
+Capability parity with ``znicz/weights_zerofilling.py`` [SURVEY.md 2.2]: hold
+a binary mask per weight tensor and re-apply it after every update so masked
+connections stay exactly zero (the reference uses this for grouped/sparse
+connectivity experiments).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def make_group_mask(
+    n_input: int, n_output: int, n_groups: int, dtype=jnp.float32
+) -> jnp.ndarray:
+    """Block-diagonal FC mask: group g of inputs connects only to group g of
+    outputs (AlexNet-style grouped connectivity for an FC layer)."""
+    if n_input % n_groups or n_output % n_groups:
+        raise ValueError(
+            f"groups {n_groups} must divide n_input {n_input} and "
+            f"n_output {n_output}"
+        )
+    gi, go = n_input // n_groups, n_output // n_groups
+    rows = jnp.arange(n_input)[:, None] // gi
+    cols = jnp.arange(n_output)[None, :] // go
+    return (rows == cols).astype(dtype)
+
+
+def apply_masks(params: Any, masks: Dict[int, Dict[str, jnp.ndarray]]):
+    """Zero out masked entries: ``masks[layer_index][param_name]`` -> mask.
+
+    Call after each optimizer update (or wrap the update fn) to keep the
+    masked weights at exactly zero.
+    """
+    if not masks:
+        return params
+    out = list(params)
+    for idx, layer_masks in masks.items():
+        layer = dict(out[idx])
+        for name, mask in layer_masks.items():
+            layer[name] = layer[name] * mask
+        out[idx] = layer
+    return type(params)(out)
+
+
+def masked_update(update_fn, masks):
+    """Wrap an optimizer.update-style callable so masks re-apply afterwards."""
+
+    def wrapped(params, grads, velocity, hyper):
+        new_p, new_v = update_fn(params, grads, velocity, hyper)
+        return apply_masks(new_p, masks), new_v
+
+    return wrapped

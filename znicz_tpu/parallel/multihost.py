@@ -1,0 +1,55 @@
+"""Multi-host bring-up: the control-plane replacement for master/slave.
+
+The reference's distributed story is a master process plus slave processes
+over ZeroMQ (``--listen`` / ``--master-address``, SURVEY.md 3.4).  The
+TPU-native equivalent is ``jax.distributed``: every host runs the SAME
+program, a coordinator rendezvous wires them into one global device mesh, and
+gradient exchange happens inside the jitted step via ICI/DCN collectives —
+no tensor ever moves over the control plane.
+
+On a multi-host pod slice (GKE/GCE TPU VMs) ``initialize()`` with no
+arguments autodetects everything.  Off-pod (the reference's ad-hoc cluster
+case) pass coordinator_address/num_processes/process_id explicitly — the
+direct analogs of --listen / --master-address.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from znicz_tpu.core.logger import setup_logging
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join (or create) the multi-host training job; returns topology info.
+
+    Call before any other jax API.  After this, ``jax.devices()`` spans the
+    whole job and ``parallel.make_mesh()`` builds global meshes.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    setup_logging()
+    info = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+    return info
+
+
+def is_coordinator() -> bool:
+    """True on exactly one process — gate snapshot writes and logging
+    (the reference's 'master does the bookkeeping' role)."""
+    import jax
+
+    return jax.process_index() == 0

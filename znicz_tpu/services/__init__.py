@@ -14,4 +14,5 @@ from znicz_tpu.services.plotting import (  # noqa: F401
     Weights2D,
 )
 from znicz_tpu.services.image_saver import ImageSaver  # noqa: F401
+from znicz_tpu.services.publishing import MarkdownReporter  # noqa: F401
 from znicz_tpu.services.web_status import StatusWriter  # noqa: F401

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: profiling/tracing, multi-host helpers."""
+
+from znicz_tpu.utils.profiling import (  # noqa: F401
+    StepTimer,
+    trace,
+)

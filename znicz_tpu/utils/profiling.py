@@ -1,0 +1,69 @@
+"""Tracing / profiling.
+
+The reference has only per-unit wall-clock accumulation surfaced to the web
+status page [SURVEY.md 5.1]; the rebuild upgrades to the jax profiler
+(Perfetto/XProf traces of actual device execution) plus lightweight host-side
+step timing that feeds the same status/metrics services.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a jax profiler trace (view with XProf/Perfetto/TensorBoard).
+
+    Usage::
+
+        with profiling.trace("/tmp/trace"):
+            workflow.run_epoch()
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir, host_tracer_level=host_tracer_level)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Accumulate per-phase wall-clock times (the reference's per-unit timing
+    ledger, SURVEY.md 5.1) without forcing device syncs: timings are host
+    dispatch+block times and are meaningful at epoch granularity."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self._start: Optional[float] = None
+        self._phase: Optional[str] = None
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "total_s": total,
+                "count": self.counts[name],
+                "mean_ms": 1000.0 * total / max(self.counts[name], 1),
+            }
+            for name, total in sorted(
+                self.totals.items(), key=lambda kv: -kv[1]
+            )
+        }
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()

@@ -41,6 +41,8 @@ class Model(NamedTuple):
     input_shape: Tuple[int, ...]  # per-sample shape (no batch dim)
     output_shape: Tuple[int, ...]
     returns_logits: bool  # final "softmax" layer emits logits (CE wants them)
+    compute_dtype: Optional[Any] = None  # bf16 mixed precision when set
+    layer_specs: Tuple[dict, ...] = ()  # original declarative specs (export)
 
     def predict(self, params, x):
         """Inference output: probabilities for softmax-headed models."""
@@ -105,11 +107,19 @@ def build(
     *,
     rand_name: str = "default",
     default_hyper: Optional[optimizer.HyperParams] = None,
+    compute_dtype: Optional[Any] = None,
 ) -> Model:
     """Compile a layer list into a Model.
 
     ``input_shape`` is the per-sample shape: ``(features,)`` for MLPs,
     ``(H, W, C)`` for conv stacks (NHWC).
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``): mixed precision — params stay
+    float32 (master weights for the update rule) but are cast per layer, and
+    activations flow in the compute dtype; matmul/conv accumulation remains
+    f32 via ``preferred_element_type``.  Halves HBM traffic for activations,
+    which is the TPU bottleneck for conv nets (MXU already multiplies in
+    bf16 either way).  The output is cast back to f32 for the loss.
     """
     default_hyper = default_hyper or optimizer.HyperParams()
     params: List[Dict[str, jnp.ndarray]] = []
@@ -261,8 +271,15 @@ def build(
                 )
             split = jax.random.split(rng, len(fns))
             keys = [split[i] if needs_rng[i] else None for i in range(len(fns))]
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+            params = jax.tree_util.tree_map(
+                lambda w: w.astype(compute_dtype), params
+            )
         for fn, p, k in zip(fns, params, keys):
             x = fn(p, x, train, k)
+        if compute_dtype is not None:
+            x = x.astype(jnp.float32)
         return x
 
     return Model(
@@ -273,4 +290,9 @@ def build(
         input_shape=tuple(int(s) for s in input_shape),
         output_shape=tuple(shape[1:]),
         returns_logits=returns_logits,
+        compute_dtype=compute_dtype,
+        layer_specs=tuple(
+            {"type": t, **_split_spec(s)[1]}
+            for t, s in zip(types, layers)
+        ),
     )

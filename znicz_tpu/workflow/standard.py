@@ -48,15 +48,21 @@ class StandardWorkflow(Workflow):
         snapshot_config: Optional[Dict[str, Any]] = None,
         lr_policy: Optional[Dict[str, Any]] = None,
         default_hyper: Optional[Dict[str, Any]] = None,
+        compute_dtype: Optional[Any] = None,
         rand_name: str = "default",
         name: str = "StandardWorkflow",
     ):
+        if isinstance(compute_dtype, str):
+            import jax.numpy as jnp
+
+            compute_dtype = jnp.dtype(compute_dtype)
         hyper = optimizer.HyperParams(**(default_hyper or {}))
         mdl = model_lib.build(
             layers,
             loader.sample_shape,
             rand_name=rand_name,
             default_hyper=hyper,
+            compute_dtype=compute_dtype,
         )
         if loss_function is None:
             loss_function = "softmax" if mdl.returns_logits else "mse"

@@ -51,6 +51,7 @@ class KohonenWorkflow(Workflow):
         decision: Optional[Decision] = None,
         snapshotter: Optional[Snapshotter] = None,
         rand_name: str = "default",
+        impl: str = "auto",  # "pallas" | "xla" | "auto" (pallas on TPU)
         name: str = "KohonenWorkflow",
     ):
         super().__init__(
@@ -67,6 +68,7 @@ class KohonenWorkflow(Workflow):
         self.total_epochs = total_epochs
         self.lr0, self.lr1, self.sigma1 = lr0, lr1, sigma1
         self.rand_name = rand_name
+        self.impl = impl
         self._n_input = int(jnp.prod(jnp.asarray(loader.sample_shape)))
 
     def _batch_target(self, mb):
@@ -76,6 +78,12 @@ class KohonenWorkflow(Workflow):
         coords = kh.grid_coords(self.sx, self.sy)
         n_steps_per_epoch = max(self.loader.n_minibatches(TRAIN), 1)
         total_steps = self.total_epochs * n_steps_per_epoch
+        use_pallas = self.impl == "pallas" or (
+            self.impl == "auto"
+            and jax.default_backend() in ("tpu", "axon")
+        )
+        if use_pallas:
+            from znicz_tpu.ops.pallas import kohonen as pallas_kh
 
         def train_step(state: TrainState, x, y, mask, lr_scale):
             x = x.reshape(x.shape[0], -1)
@@ -88,14 +96,25 @@ class KohonenWorkflow(Workflow):
                 sx=self.sx,
                 sy=self.sy,
             )
-            params, win = kh.train_step(
-                state.params,
-                x,
-                coords,
-                learning_rate=lr * lr_scale,
-                sigma=sigma,
-                mask=mask,
-            )
+            if use_pallas:
+                win = kh.winners(state.params, x)
+                params = pallas_kh.train_step(
+                    state.params,
+                    x,
+                    coords,
+                    learning_rate=lr * lr_scale,
+                    sigma=sigma,
+                    mask=mask,
+                )
+            else:
+                params, win = kh.train_step(
+                    state.params,
+                    x,
+                    coords,
+                    learning_rate=lr * lr_scale,
+                    sigma=sigma,
+                    mask=mask,
+                )
             metrics = self._qe(params, x, win, mask)
             return state._replace(params=params, step=state.step + 1), metrics
 

@@ -178,7 +178,9 @@ class Workflow(Logger):
         )
         for split, mb in self.loader.epoch():
             x = put(mb.data)
-            y = put(self._batch_target(mb))
+            # autoencoder target IS the input: reuse the device array
+            # instead of transferring the batch twice
+            y = x if self.target == "input" else put(self._batch_target(mb))
             mask = put(mb.mask)
             if split == TRAIN:
                 lr_scale = (
