@@ -21,6 +21,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(scope="session")
 def znicz_infer(tmp_path_factory):
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available: skipping native-engine parity tests")
     exe = str(tmp_path_factory.mktemp("native") / "znicz_infer")
     subprocess.run(
         [

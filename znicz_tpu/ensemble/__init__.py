@@ -89,23 +89,27 @@ class Ensemble(Logger):
         return counts.argmax(axis=0)
 
     def evaluate(self, split: str = "test") -> dict:
-        """Aggregate error rate of the ensemble vs. the mean member."""
+        """Aggregate error rate of the ensemble vs. the mean member.
+
+        Each member's forward runs ONCE per batch; the ensemble vote and
+        the per-member errors both derive from those probabilities.
+        """
         loader = self.workflows[0].loader
         n_err, n, member_errs = 0, 0, np.zeros(len(self.workflows))
         for mb in loader.batches(split):
             valid = mb.mask > 0
-            pred = self.predict(mb.data)[valid]
             labels = mb.labels[valid]
-            n_err += int((pred != labels).sum())
+            probs = [
+                np.asarray(
+                    wf.model.predict(wf.state.params, jnp.asarray(mb.data))
+                )
+                for wf in self.workflows
+            ]
+            ens_pred = np.mean(probs, axis=0).argmax(axis=1)[valid]
+            n_err += int((ens_pred != labels).sum())
             n += int(valid.sum())
-            for i, wf in enumerate(self.workflows):
-                p = np.asarray(
-                    jnp.argmax(
-                        wf.model.predict(wf.state.params, jnp.asarray(mb.data)),
-                        axis=1,
-                    )
-                )[valid]
-                member_errs[i] += (p != labels).sum()
+            for i, p in enumerate(probs):
+                member_errs[i] += (p.argmax(axis=1)[valid] != labels).sum()
         return {
             "n_samples": n,
             "ensemble_err_pct": 100.0 * n_err / max(n, 1),

@@ -43,10 +43,13 @@ def translate_unsupervised_overrides(kwargs: dict, epochs_key: str) -> dict:
     if dc:
         if "max_epochs" in dc:
             kwargs[epochs_key] = dc["max_epochs"]
-        # honor the remaining Decision knobs (fail_iterations, ...) too
+        # honor the remaining Decision knobs (fail_iterations, ...) too;
+        # an epoch cap must always exist — fall back to the workflow's own
+        # epoch budget when the caller didn't set one
         from znicz_tpu.nn.decision import Decision
 
-        kwargs.setdefault("decision", Decision(metric="loss", **dc))
+        dc_full = {"max_epochs": kwargs.get(epochs_key), **dc}
+        kwargs.setdefault("decision", Decision(metric="loss", **dc_full))
     return kwargs
 
 
