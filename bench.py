@@ -58,7 +58,7 @@ def main() -> None:
     from znicz_tpu.core.config import root
     from znicz_tpu.models import alexnet
 
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     root.alexnet.loader.update(
         {"minibatch_size": batch, "n_train": batch, "n_valid": 0}
@@ -106,6 +106,37 @@ def main() -> None:
         dt = t_long / (3 * steps)
 
     images_per_sec = batch / dt
+
+    # secondary metric (BASELINE.json): MNIST MLP step latency
+    from znicz_tpu.models import mnist as mnist_model
+
+    root.mnist.loader.update(
+        {"minibatch_size": 100, "n_train": 100, "n_test": 0,
+         "validation_ratio": 0.0}
+    )
+    mwf = mnist_model.build_workflow()
+    mwf.initialize(seed=1234)
+    mmb = next(iter(mwf.loader.batches("train")))
+    mx, my, mmask = (
+        jnp.asarray(mmb.data), jnp.asarray(mmb.labels), jnp.asarray(mmb.mask)
+    )
+    mstate = mwf.state
+
+    def mnist_timed(n):
+        nonlocal mstate
+        t0 = time.time()
+        for _ in range(n):
+            mstate, mm = mwf._train_step(mstate, mx, my, mmask, 1.0)
+        float(mm["loss"])
+        return time.time() - t0
+
+    # sub-ms steps: long runs so relay sync noise (~100ms) stays <10%
+    mnist_timed(3)
+    mnist_timed(3)
+    m_short, m_long = mnist_timed(300), mnist_timed(900)
+    mnist_step_ms = max(m_long - m_short, 0) / 600 * 1000
+    if mnist_step_ms <= 0:
+        mnist_step_ms = m_long / 900 * 1000
     fwd_flops = _model_flops_per_image(
         root.alexnet.get("layers"), wf.loader.sample_shape
     )
@@ -123,6 +154,7 @@ def main() -> None:
                 "mfu": round(mfu, 4),
                 "batch": batch,
                 "step_ms": round(1000 * dt, 2),
+                "mnist_mlp_step_ms": round(mnist_step_ms, 3),
                 "device": str(jax.devices()[0].device_kind),
             }
         )

@@ -85,14 +85,18 @@ class TestOptimizeCLI:
             "    load(wine.build_workflow)\n"
             "    main()\n"
         )
+        out = tmp_path / "best.znicz"
         launcher = run_args(
             [
                 str(wf_py),
                 "--random-seed", "11",
                 "--stop-after", "2",
                 "--optimize", "2",
+                "--export", str(out),
             ]
         )
         assert launcher.result is not None
         assert np.isfinite(launcher.result["best_fitness"])
         assert len(launcher.result["history"]) == 2
+        # export happens once, AFTER the search, with the best config applied
+        assert out.read_bytes()[:8] == b"ZNICZT01"

@@ -1,6 +1,7 @@
 """Tests for the loader layer: split bookkeeping, masking, shuffling."""
 
 import numpy as np
+import pytest
 
 from znicz_tpu.core import prng
 from znicz_tpu.loader import FullBatchLoader, datasets, normalizers
@@ -177,6 +178,142 @@ class TestReviewRegressions:
         resumed = [next(iter(ld2.batches("train"))).indices for _ in range(3)]
         for a, b in zip(later, resumed):
             np.testing.assert_array_equal(a, b)
+
+
+class TestBalancedShuffle:
+    def test_every_batch_has_proportional_mix(self):
+        # 90/10 imbalance: with balanced=True each size-10 batch holds ~1
+        # minority sample instead of clumping
+        prng.seed_all(3)
+        x = np.zeros((100, 4), np.float32)
+        y = np.array([0] * 90 + [1] * 10, np.int32)
+        ld = FullBatchLoader(
+            {"train": x}, {"train": y}, minibatch_size=10, balanced=True
+        )
+        for mb in ld.batches("train"):
+            minority = int((mb.labels[mb.mask > 0] == 1).sum())
+            assert minority in (0, 1, 2)  # near-proportional, never clumped
+        # all samples still served exactly once
+        seen = np.concatenate(
+            [mb.indices[mb.mask > 0] for mb in ld.batches("train")]
+        )
+        assert sorted(seen.tolist()) == list(range(100))
+
+    def test_unbalanced_default_unchanged(self):
+        prng.seed_all(3)
+        x = np.zeros((20, 2), np.float32)
+        ld = FullBatchLoader({"train": x}, minibatch_size=5)
+        assert ld.balanced is False
+        list(ld.batches("train"))
+
+
+class TestImageDirectoryLoader:
+    def _make_tree(self, tmp_path, n_per_class=4, classes=("cat", "dog")):
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.image as mpimg
+
+        rng = np.random.default_rng(0)
+        for split, n in (("train", n_per_class), ("test", 2)):
+            for ci, cls in enumerate(classes):
+                d = tmp_path / split / cls
+                d.mkdir(parents=True, exist_ok=True)
+                for i in range(n):
+                    img = rng.random((8, 8, 3)).astype(np.float32)
+                    img[:, :, ci % 3] = 1.0  # class-correlated channel
+                    mpimg.imsave(str(d / f"{i}.png"), img)
+        return tmp_path
+
+    def test_loads_and_labels(self, tmp_path):
+        from znicz_tpu.loader.image import ImageDirectoryLoader
+
+        root_dir = self._make_tree(tmp_path)
+        ld = ImageDirectoryLoader(str(root_dir), minibatch_size=4)
+        assert ld.class_lengths == {"train": 8, "test": 4}
+        assert ld.classes == ["cat", "dog"]
+        assert ld.sample_shape == (8, 8, 3)
+        mb = next(iter(ld.batches("train")))
+        assert mb.data.shape == (4, 8, 8, 3)
+        assert mb.data.max() <= 1.0
+        # labels come from directory names
+        seen = set()
+        for b in ld.batches("train"):
+            seen.update(b.labels[b.mask > 0].tolist())
+        assert seen == {0, 1}
+
+    def test_resize_and_grayscale(self, tmp_path):
+        from znicz_tpu.loader.image import ImageDirectoryLoader
+
+        root_dir = self._make_tree(tmp_path)
+        ld = ImageDirectoryLoader(
+            str(root_dir),
+            target_shape=(4, 4),
+            grayscale=True,
+            minibatch_size=4,
+        )
+        mb = next(iter(ld.batches("train")))
+        assert mb.data.shape == (4, 4, 4, 1)
+
+    def test_missing_dir_raises(self, tmp_path):
+        from znicz_tpu.loader.image import ImageDirectoryLoader
+
+        with pytest.raises(FileNotFoundError):
+            ImageDirectoryLoader(str(tmp_path / "nope"))
+
+    def test_balanced_uses_directory_labels(self, tmp_path):
+        from znicz_tpu.loader.image import ImageDirectoryLoader
+
+        root_dir = self._make_tree(tmp_path, n_per_class=8)
+        ld = ImageDirectoryLoader(
+            str(root_dir), minibatch_size=4, balanced=True
+        )
+        labels = ld.split_labels("train")
+        assert sorted(labels.tolist()) == [0] * 8 + [1] * 8
+        for mb in ld.batches("train"):
+            valid = mb.labels[mb.mask > 0]
+            assert set(valid.tolist()) == {0, 1}  # every batch mixed
+
+    def test_empty_class_dir_ignored(self, tmp_path):
+        from znicz_tpu.loader.image import ImageDirectoryLoader
+
+        root_dir = self._make_tree(tmp_path)
+        (root_dir / "train" / "phantom").mkdir()
+        (root_dir / "train" / "phantom" / "notes.txt").write_text("x")
+        ld = ImageDirectoryLoader(str(root_dir), minibatch_size=4)
+        assert ld.classes == ["cat", "dog"]
+
+    def test_grayscale_inferred_shape(self, tmp_path):
+        from znicz_tpu.loader.image import ImageDirectoryLoader
+
+        root_dir = self._make_tree(tmp_path)
+        ld = ImageDirectoryLoader(
+            str(root_dir), grayscale=True, minibatch_size=4
+        )
+        # inferred target must honor grayscale, and averaging (not
+        # red-channel slicing) must be used: cat images have red=1.0
+        assert ld.sample_shape == (8, 8, 1)
+        mb = next(iter(ld.batches("train")))
+        assert float(mb.data.max()) < 1.0  # mean of (1, r, r) < 1
+
+    def test_trains_in_workflow(self, tmp_path):
+        from znicz_tpu.loader.image import ImageDirectoryLoader
+        from znicz_tpu.workflow import StandardWorkflow
+
+        root_dir = self._make_tree(tmp_path, n_per_class=8)
+        ld = ImageDirectoryLoader(str(root_dir), minibatch_size=8)
+        wf = StandardWorkflow(
+            ld,
+            [
+                {"type": "all2all_tanh", "->": {"output_sample_shape": 8}},
+                {"type": "softmax", "->": {"output_sample_shape": 2}},
+            ],
+            decision_config={"max_epochs": 8},
+            default_hyper={"learning_rate": 0.2, "gradient_moment": 0.9},
+        )
+        wf.initialize(seed=3)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["n_err"] == 0  # separable by channel
 
 
 def test_split_sizes():

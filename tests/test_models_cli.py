@@ -197,6 +197,23 @@ class TestLauncherCLI:
         )
         assert launcher.result.epoch == 3
 
+    def test_export_flag(self, tmp_path):
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        out = tmp_path / "wine.znicz"
+        run_args(
+            [
+                str(wf_py),
+                "--random-seed", "3",
+                "--stop-after", "1",
+                "--export", str(out),
+            ]
+        )
+        blob = out.read_bytes()
+        assert blob[:8] == b"ZNICZT01"
+
     def test_missing_run_convention_errors(self, tmp_path):
         bad = tmp_path / "bad.py"
         bad.write_text("x = 1\n")

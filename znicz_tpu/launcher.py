@@ -62,6 +62,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="override the workflow's max_epochs")
     p.add_argument("--optimize", type=int, default=None, metavar="GENS",
                    help="genetic hyperparameter search for N generations")
+    p.add_argument("--export", default=None, metavar="MODEL.znicz",
+                   help="after training, export the model for the native "
+                        "inference engine (native/znicz_infer)")
     p.add_argument("--dry-run", action="store_true",
                    help="build and initialize the workflow, run nothing")
     p.add_argument("--verbose", action="store_true")
@@ -99,6 +102,12 @@ class Launcher(Logger):
         """Initialize and run the loaded workflow."""
         if self.workflow is None:
             raise RuntimeError("run(load, main): call load(...) before main()")
+        if self.args.export and not hasattr(self.workflow.model, "_replace"):
+            # fail BEFORE training, not after hours of it
+            raise SystemExit(
+                "--export supports layer-list models (StandardWorkflow); "
+                f"{type(self.workflow).__name__} has no exportable model"
+            )
         self.workflow.initialize(
             seed=self.args.random_seed, snapshot=self.args.snapshot, **kwargs
         )
@@ -106,6 +115,16 @@ class Launcher(Logger):
             self.info("dry run: workflow initialized, skipping run()")
             return None
         self.result = self.workflow.run()
+        if self.args.export:
+            import jax
+
+            from znicz_tpu.export import export_model
+
+            trained = self.workflow.model._replace(
+                params=jax.device_get(self.workflow.state.params)
+            )
+            export_model(trained, self.args.export)
+            self.info("exported trained model to %s", self.args.export)
         return self.result
 
 
@@ -137,9 +156,18 @@ def run_args(argv=None) -> Launcher:
     if args.optimize:
         from znicz_tpu.genetics import optimize_workflow
 
+        # export must capture the BEST genome's weights, not whichever
+        # candidate trained last: defer it past the search, then retrain
+        # once with the winning config applied
+        export_path, args.export = args.export, None
         launcher.result = optimize_workflow(
             module, launcher, generations=args.optimize
         )
+        if export_path:
+            args.export = export_path
+            opt_result = launcher.result
+            module.run(launcher.load, launcher.main)
+            launcher.result = opt_result  # keep the search summary
         return launcher
     module.run(launcher.load, launcher.main)
     return launcher

@@ -40,10 +40,12 @@ class Loader:
         *,
         minibatch_size: int = 100,
         shuffle: bool = True,
+        balanced: bool = False,
         rand_name: str = "loader",
     ):
         self.max_minibatch_size = int(minibatch_size)
         self.shuffle = shuffle
+        self.balanced = balanced  # spread classes evenly across minibatches
         self.rand_name = rand_name
         self._order: Dict[str, np.ndarray] = {}
         self.epoch_number = 0
@@ -63,6 +65,10 @@ class Loader:
         """Materialize the samples at ``indices`` of ``split``."""
         raise NotImplementedError
 
+    def split_labels(self, split: str) -> Optional[np.ndarray]:
+        """All labels of a split (enables ``balanced``); None if unknown."""
+        return None
+
     # -- serving -----------------------------------------------------------
     def n_minibatches(self, split: str) -> int:
         n = self.class_lengths.get(split, 0)
@@ -78,8 +84,25 @@ class Loader:
 
     def reshuffle(self, split: str = TRAIN) -> None:
         n = self.class_lengths.get(split, 0)
-        if n:
-            self._order[split] = prng.get(self.rand_name).permutation(n)
+        if not n:
+            return
+        gen = prng.get(self.rand_name)
+        labels = self.split_labels(split) if self.balanced else None
+        if labels is None:
+            self._order[split] = gen.permutation(n)
+            return
+        # class-balanced shuffle (reference "class-balanced offsets",
+        # SURVEY.md §7): shuffle within each class, then place sample ranked
+        # r of a size-m class at fractional position (r + jitter)/m so every
+        # minibatch sees a near-proportional class mix
+        labels = np.asarray(labels)
+        keys = np.empty(n, np.float64)
+        for cls in np.unique(labels):
+            idx = np.flatnonzero(labels == cls)
+            perm = idx[gen.permutation(len(idx))]
+            jitter = gen.uniform((len(idx),), 0.0, 1.0)
+            keys[perm] = (np.arange(len(idx)) + jitter) / len(idx)
+        self._order[split] = np.argsort(keys, kind="stable")
 
     def batches(self, split: str) -> Iterator[Minibatch]:
         """Yield padded fixed-shape minibatches covering the split once."""

@@ -75,6 +75,9 @@ class FullBatchLoader(Loader):
     def sample_shape(self) -> tuple:
         return next(iter(self.data.values())).shape[1:]
 
+    def split_labels(self, split: str):
+        return self.labels.get(split)
+
     def fill(self, indices: np.ndarray, split: str) -> Minibatch:
         data = self.data[split][indices]
         labels = (

@@ -1,0 +1,143 @@
+"""File-system image loader.
+
+Capability parity with the reference image loaders (``veles/loader/image.py``,
+``znicz/loader/`` file-system image pipelines [SURVEY.md 2.1 "Data loader
+base", 2.3 "Znicz loaders"]): ingest a directory tree of image files into
+train/valid/test minibatches with labels from directory names.
+
+Layout (reference convention):
+    root/train/<class_name>/*.png
+    root/valid/<class_name>/*.png   (optional)
+    root/test/<class_name>/*.png    (optional)
+
+Images load lazily per minibatch (streaming — datasets larger than host
+memory work), decoded with matplotlib (PNG) and resized by nearest-neighbor
+to a common ``target_shape``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from znicz_tpu.loader.base import SPLITS, Loader, Minibatch
+
+IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+def _read_image(path: str) -> np.ndarray:
+    import matplotlib.image as mpimg
+
+    raw = np.asarray(mpimg.imread(path))
+    # integer-decoded formats (JPEG) are 0..255; float (PNG) already 0..1 —
+    # decide by dtype, never by content, so dark images scale consistently
+    scale = 255.0 if np.issubdtype(raw.dtype, np.integer) else 1.0
+    img = raw.astype(np.float32) / scale
+    if img.ndim == 2:
+        img = img[..., None]
+    if img.shape[-1] == 4:  # drop alpha
+        img = img[..., :3]
+    return img
+
+
+def _resize_nearest(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    rows = (np.arange(h) * ih / h).astype(np.int64)
+    cols = (np.arange(w) * iw / w).astype(np.int64)
+    return img[rows][:, cols]
+
+
+class ImageDirectoryLoader(Loader):
+    """Serve labeled images from a directory tree, lazily.
+
+    ``target_shape``: (H, W) or (H, W, C); channels inferred from the first
+    image when omitted.  ``grayscale``: average channels to 1.
+    """
+
+    def __init__(
+        self,
+        root_dir: str,
+        *,
+        target_shape: Optional[Tuple[int, ...]] = None,
+        grayscale: bool = False,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.root_dir = root_dir
+        self.grayscale = grayscale
+        self.index: Dict[str, List[Tuple[str, int]]] = {}
+        classes: List[str] = []
+        for split in SPLITS:
+            split_dir = os.path.join(root_dir, split)
+            if not os.path.isdir(split_dir):
+                continue
+            entries: List[Tuple[str, int]] = []
+            for cls in sorted(os.listdir(split_dir)):
+                cls_dir = os.path.join(split_dir, cls)
+                if not os.path.isdir(cls_dir):
+                    continue
+                files = [
+                    os.path.join(cls_dir, fname)
+                    for fname in sorted(os.listdir(cls_dir))
+                    if fname.lower().endswith(IMAGE_EXTENSIONS)
+                ]
+                if not files:
+                    continue  # a class only exists if it has samples
+                if cls not in classes:
+                    classes.append(cls)
+                label = classes.index(cls)
+                entries.extend((path, label) for path in files)
+            if entries:
+                self.index[split] = entries
+        if not self.index:
+            raise FileNotFoundError(
+                f"no {'/'.join(SPLITS)}/<class>/*.png images under {root_dir}"
+            )
+        self.classes = classes
+        if target_shape is None:
+            first = _read_image(self.index[next(iter(self.index))][0][0])
+            target_shape = first.shape[:2]  # channels decided below
+        if len(target_shape) == 2:
+            target_shape = tuple(target_shape) + (1 if grayscale else 3,)
+        if grayscale and target_shape[-1] != 1:
+            raise ValueError(
+                f"grayscale=True conflicts with target_shape {target_shape}"
+            )
+        self.target_shape = tuple(int(s) for s in target_shape)
+
+    @property
+    def class_lengths(self) -> Dict[str, int]:
+        return {split: len(v) for split, v in self.index.items()}
+
+    @property
+    def sample_shape(self) -> tuple:
+        return self.target_shape
+
+    def split_labels(self, split: str):
+        # enables balanced=True minibatch serving (Loader.reshuffle)
+        return np.asarray([label for _, label in self.index[split]], np.int32)
+
+    def fill(self, indices: np.ndarray, split: str) -> Minibatch:
+        h, w, c = self.target_shape
+        data = np.zeros((len(indices), h, w, c), np.float32)
+        labels = np.zeros(len(indices), np.int32)
+        entries = self.index[split]
+        for row, idx in enumerate(indices):
+            path, label = entries[int(idx)]
+            img = _resize_nearest(_read_image(path), h, w)
+            if img.shape[-1] != c:
+                if c == 1:  # color source, gray target: average (not slice)
+                    img = img.mean(axis=-1, keepdims=True)
+                elif img.shape[-1] == 1:  # gray source, color target
+                    img = np.repeat(img, c, axis=-1)
+                else:
+                    img = img[:, :, :c]
+            data[row] = img
+            labels[row] = label
+        return Minibatch(
+            data=data, labels=labels, targets=None, mask=None, indices=indices
+        )
