@@ -1,0 +1,79 @@
+// batch_assembler: native minibatch assembly for the loader hot path.
+//
+// The reference's data-plane hot paths are native (CL/CUDA kernels fed by
+// C-backed numpy ops); this keeps the rebuilt loader's per-step work native
+// too (SURVEY.md 2.4 rebuild mapping).  Exposed as a plain C ABI for ctypes
+// (the environment has no pybind11).  All functions are thread-parallel.
+//
+// Build:  g++ -O3 -march=native -shared -fPIC -o libbatch_assembler.so \
+//             batch_assembler.cc -pthread
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// run fn(begin, end) over [0, n) split across hardware threads
+template <typename Fn>
+void parallel_for(int64_t n, Fn fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t n_threads = hw ? static_cast<int64_t>(hw) : 4;
+  if (n_threads > n) n_threads = n > 0 ? n : 1;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  std::vector<std::thread> threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    int64_t begin = t * chunk;
+    int64_t end = begin + chunk < n ? begin + chunk : n;
+    if (begin >= end) break;
+    threads.emplace_back([=] { fn(begin, end); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows: out[i, :] = data[indices[i], :].  f32, row-major.
+void gather_rows_f32(const float* data, int64_t feat, const int64_t* indices,
+                     int64_t batch, float* out) {
+  parallel_for(batch, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      std::memcpy(out + i * feat, data + indices[i] * feat,
+                  static_cast<size_t>(feat) * sizeof(float));
+    }
+  });
+}
+
+// Gather rows from uint8 storage with affine normalize:
+// out[i, j] = data[indices[i], j] / scale + shift.
+// Keeps the dataset in u8 (4x less host RAM) and converts per batch.
+void gather_rows_u8_normalize(const uint8_t* data, int64_t feat,
+                              const int64_t* indices, int64_t batch,
+                              float scale, float shift, float* out) {
+  float inv = 1.0f / scale;
+  parallel_for(batch, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      const uint8_t* src = data + indices[i] * feat;
+      float* dst = out + i * feat;
+      for (int64_t j = 0; j < feat; ++j) dst[j] = src[j] * inv + shift;
+    }
+  });
+}
+
+// In-place affine normalize of an f32 block (mean/disp style per-feature).
+// out[i, j] = (out[i, j] - mean[j]) * inv_disp[j]
+void normalize_rows_f32(float* data, int64_t rows, int64_t feat,
+                        const float* mean, const float* inv_disp) {
+  parallel_for(rows, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      float* row = data + i * feat;
+      for (int64_t j = 0; j < feat; ++j)
+        row[j] = (row[j] - mean[j]) * inv_disp[j];
+    }
+  });
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+"""Native batch assembler vs numpy fallback (loader hot path)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.loader import native
+
+
+@pytest.fixture(scope="session")
+def native_available():
+    if not native.available():
+        pytest.skip("native batch assembler unavailable (no g++?)")
+    return True
+
+
+class TestGatherRows:
+    def test_matches_numpy(self, native_available):
+        rng = np.random.default_rng(0)
+        data = rng.random((50, 17), np.float32)
+        idx = rng.integers(0, 50, 23)
+        np.testing.assert_array_equal(
+            native.gather_rows(data, idx), data[idx]
+        )
+
+    def test_multidim_shapes(self, native_available):
+        rng = np.random.default_rng(1)
+        data = rng.random((20, 4, 5, 3), np.float32).astype(np.float32)
+        idx = np.array([3, 1, 19, 0])
+        out = native.gather_rows(data, idx)
+        assert out.shape == (4, 4, 5, 3)
+        np.testing.assert_array_equal(out, data[idx])
+
+    def test_non_f32_falls_back(self):
+        data = np.arange(12, dtype=np.float64).reshape(4, 3)
+        idx = np.array([2, 0])
+        np.testing.assert_array_equal(
+            native.gather_rows(data, idx), data[idx]
+        )
+
+    def test_u8_normalize(self, native_available):
+        rng = np.random.default_rng(2)
+        data = rng.integers(0, 256, (30, 11)).astype(np.uint8)
+        idx = rng.integers(0, 30, 8)
+        out = native.gather_rows_u8(data, idx, scale=255.0, shift=-0.5)
+        # native uses x * (1/scale): one-ulp difference vs division
+        np.testing.assert_allclose(
+            out, data[idx].astype(np.float32) / 255.0 - 0.5, atol=1e-6
+        )
+
+    def test_used_by_fullbatch_loader(self, native_available):
+        from znicz_tpu.loader import FullBatchLoader
+
+        x = np.arange(40, dtype=np.float32).reshape(10, 4)
+        ld = FullBatchLoader(
+            {"train": x}, minibatch_size=4, shuffle=False
+        )
+        mb = next(iter(ld.batches("train")))
+        np.testing.assert_array_equal(mb.data, x[:4])
+
+    def test_fullbatch_lazy_u8_path(self):
+        # u8 data + range normalization: dataset stays u8 in memory and
+        # minibatches come out converted — the fused native pipeline
+        from znicz_tpu.loader import FullBatchLoader
+
+        rng = np.random.default_rng(3)
+        x = rng.integers(0, 256, (12, 2, 2, 1)).astype(np.uint8)
+        ld = FullBatchLoader(
+            {"train": x},
+            minibatch_size=4,
+            shuffle=False,
+            normalization="range",
+            normalization_kwargs={"scale": 255.0, "shift": -0.5},
+        )
+        assert ld._lazy_u8
+        assert ld.data["train"].dtype == np.uint8  # stays u8 at rest
+        mb = next(iter(ld.batches("train")))
+        assert mb.data.dtype == np.float32
+        np.testing.assert_allclose(
+            mb.data, x[:4].astype(np.float32) / 255.0 - 0.5, atol=1e-6
+        )
+
+    def test_evaluation_batches_do_not_touch_shuffle_stream(self):
+        # regression: batches(shuffle=False) must not draw from the PRNG
+        from znicz_tpu.core import prng
+        from znicz_tpu.loader import FullBatchLoader
+
+        prng.seed_all(5)
+        x = np.zeros((20, 2), np.float32)
+        ld = FullBatchLoader({"train": x}, minibatch_size=5)
+        list(ld.batches("train"))  # one shuffled epoch
+        state_before = prng.get(ld.rand_name).state_dict()
+        list(ld.batches("train", shuffle=False))  # read-only pass
+        state_after = prng.get(ld.rand_name).state_dict()
+        np.testing.assert_array_equal(
+            state_before["key"], state_after["key"]
+        )

@@ -162,6 +162,29 @@ class TestTraining:
         assert int(wf.state.step) == 3  # 192 train / 64
 
 
+class TestEvaluate:
+    def test_confusion_matrix_sums_over_batches(self):
+        prng.seed_all(8)
+        wf = _mnist_workflow(max_epochs=2)
+        wf.initialize(seed=8)
+        wf.run()
+        result = wf.evaluate("test", confusion=True)
+        conf = result["confusion"]
+        assert conf.shape == (10, 10)
+        assert conf.sum() == result["n_samples"] == 64
+        # diagonal dominance after training on separable synthetic data
+        assert np.trace(conf) == result["n_samples"] - result["n_err"]
+
+    def test_timer_ledger_populated(self):
+        prng.seed_all(8)
+        wf = _mnist_workflow(max_epochs=1)
+        wf.initialize(seed=8)
+        wf.run()
+        s = wf.timer.summary()
+        assert "dispatch/train" in s and "metrics_sync" in s
+        assert s["dispatch/train"]["count"] == 3  # 192 train / 64
+
+
 class TestSnapshotResume:
     def test_resume_matches_uninterrupted(self, tmp_path):
         # uninterrupted run: 6 epochs

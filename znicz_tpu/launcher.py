@@ -160,6 +160,12 @@ def run_args(argv=None) -> Launcher:
         # candidate trained last: defer it past the search, then retrain
         # once with the winning config applied
         export_path, args.export = args.export, None
+        if export_path:
+            # exportability must fail BEFORE a long search, not after it:
+            # probe with a dry run (builds the workflow, trains nothing)
+            args.export, args.dry_run, saved_dry = export_path, True, args.dry_run
+            module.run(launcher.load, launcher.main)
+            args.export, args.dry_run = None, saved_dry
         launcher.result = optimize_workflow(
             module, launcher, generations=args.optimize
         )

@@ -104,12 +104,21 @@ class Loader:
             keys[perm] = (np.arange(len(idx)) + jitter) / len(idx)
         self._order[split] = np.argsort(keys, kind="stable")
 
-    def batches(self, split: str) -> Iterator[Minibatch]:
-        """Yield padded fixed-shape minibatches covering the split once."""
+    def batches(
+        self, split: str, *, shuffle: Optional[bool] = None
+    ) -> Iterator[Minibatch]:
+        """Yield padded fixed-shape minibatches covering the split once.
+
+        ``shuffle=False`` serves the current order WITHOUT drawing from the
+        shuffle PRNG stream — evaluation passes must be read-only so they
+        don't desynchronize resume determinism.
+        """
         n = self.class_lengths.get(split, 0)
         if not n:
             return
-        if split == TRAIN and self.shuffle:
+        if shuffle is None:
+            shuffle = split == TRAIN and self.shuffle
+        if shuffle:
             self.reshuffle(split)
         order = self._split_order(split)
         bs = self.max_minibatch_size

@@ -101,9 +101,16 @@ def mnist(
                 ip = os.path.join(data_dir, ims + suffix)
                 lp = os.path.join(data_dir, labs + suffix)
                 if os.path.exists(ip) and os.path.exists(lp):
-                    data[split] = _read_idx(ip).astype(np.float32) / 255.0 - 0.5
+                    # keep u8: the loader's lazy range-normalization path
+                    # converts per minibatch (fused native gather)
+                    data[split] = _read_idx(ip)
                     labels[split] = _read_idx(lp).astype(np.int32)
                     break
+        if data:
+            loader_kwargs.setdefault("normalization", "range")
+            loader_kwargs.setdefault(
+                "normalization_kwargs", {"scale": 255.0, "shift": -0.5}
+            )
         if set(data) not in (set(), {"train", "test"}):
             raise FileNotFoundError(
                 f"{data_dir} holds only the {sorted(data)} MNIST split(s); "
@@ -151,7 +158,8 @@ def cifar10(
             xs.append(np.asarray(d[b"data"], np.uint8))
             ys.append(np.asarray(d[b"labels"], np.int32))
         x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        return x.astype(np.float32) / 255.0 - 0.5, np.concatenate(ys)
+        # keep u8 NHWC: lazy range-normalization converts per minibatch
+        return np.ascontiguousarray(x), np.concatenate(ys)
 
     loaded = False
     if data_dir:
@@ -162,6 +170,10 @@ def cifar10(
         if all(os.path.exists(p) for p in batch_paths + [test_path]):
             data["train"], labels["train"] = _load_batches(batch_paths)
             data["test"], labels["test"] = _load_batches([test_path])
+            loader_kwargs.setdefault("normalization", "range")
+            loader_kwargs.setdefault(
+                "normalization_kwargs", {"scale": 255.0, "shift": -0.5}
+            )
             loaded = True
     if not loaded:
         data, labels = _synthetic_split(n_train, n_test, (32, 32, 3), 10)

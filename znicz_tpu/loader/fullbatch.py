@@ -59,13 +59,20 @@ class FullBatchLoader(Loader):
             fit_src.reshape(len(fit_src), -1),
             **(normalization_kwargs or {}),
         )
-        # Normalize each immutable split ONCE here, not per minibatch.
-        self.data = {
-            split: normalizers.apply(
-                self.normalizer, raw.reshape(len(raw), -1).astype(np.float32)
-            ).reshape(raw.shape)
-            for split, raw in self.data.items()
-        }
+        # uint8 data + "range" normalization stays u8 (4x less host RAM):
+        # the affine convert fuses into the per-minibatch native gather.
+        self._lazy_u8 = all(
+            raw.dtype == np.uint8 for raw in self.data.values()
+        ) and self.normalizer["kind"] == "range"
+        if not self._lazy_u8:
+            # Normalize each immutable split ONCE here, not per minibatch.
+            self.data = {
+                split: normalizers.apply(
+                    self.normalizer,
+                    raw.reshape(len(raw), -1).astype(np.float32),
+                ).reshape(raw.shape)
+                for split, raw in self.data.items()
+            }
 
     @property
     def class_lengths(self) -> Dict[str, int]:
@@ -79,7 +86,20 @@ class FullBatchLoader(Loader):
         return self.labels.get(split)
 
     def fill(self, indices: np.ndarray, split: str) -> Minibatch:
-        data = self.data[split][indices]
+        raw = self.data[split]
+        if self._lazy_u8:
+            # fused native gather + u8->f32 affine normalize (~3x faster
+            # than the numpy chain; numpy fallback inside)
+            from znicz_tpu.loader import native
+
+            data = native.gather_rows_u8(
+                raw,
+                indices,
+                scale=self.normalizer["scale"],
+                shift=self.normalizer["shift"],
+            )
+        else:
+            data = raw[indices]  # plain f32 gather: numpy already optimal
         labels = (
             self.labels[split][indices] if split in self.labels else None
         )

@@ -36,6 +36,13 @@ class StatusWriter:
             "devices": self._devices(),
             "summary": verdict["summary"],
             "history_len": len(dec.history),
+            # per-phase wall-clock ledger (reference per-unit timing on the
+            # status page, SURVEY.md 5.1)
+            "timing": (
+                workflow.timer.summary()
+                if getattr(workflow, "timer", None)
+                else {}
+            ),
         }
         with open(os.path.join(self.directory, "status.json"), "w") as f:
             json.dump(status, f, indent=2)

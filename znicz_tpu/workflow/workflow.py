@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.core.logger import Logger
@@ -66,6 +67,9 @@ class Workflow(Logger):
         self._train_step = None
         self._eval_step = None
         self._host_step = 0
+        from znicz_tpu.utils.profiling import StepTimer
+
+        self.timer = StepTimer()  # per-phase ledger (SURVEY.md 5.1)
 
     # ------------------------------------------------------------------
     def _metrics(self, out, y, mask):
@@ -177,28 +181,34 @@ class Workflow(Logger):
             self.parallel.shard_batch if self.parallel is not None else jnp.asarray
         )
         for split, mb in self.loader.epoch():
-            x = put(mb.data)
-            # autoencoder target IS the input: reuse the device array
-            # instead of transferring the batch twice
-            y = x if self.target == "input" else put(self._batch_target(mb))
-            mask = put(mb.mask)
-            if split == TRAIN:
-                lr_scale = (
-                    self.lr_policy(1.0, self._host_step)
-                    if self.lr_policy
-                    else 1.0
+            with self.timer.phase(f"dispatch/{split}"):
+                x = put(mb.data)
+                # autoencoder target IS the input: reuse the device array
+                # instead of transferring the batch twice
+                y = (
+                    x
+                    if self.target == "input"
+                    else put(self._batch_target(mb))
                 )
-                self.state, metrics = self._train_step(
-                    self.state, x, y, mask, lr_scale
-                )
-                self._host_step += 1
-            else:
-                metrics = self._eval_step(self.state.params, x, y, mask)
+                mask = put(mb.mask)
+                if split == TRAIN:
+                    lr_scale = (
+                        self.lr_policy(1.0, self._host_step)
+                        if self.lr_policy
+                        else 1.0
+                    )
+                    self.state, metrics = self._train_step(
+                        self.state, x, y, mask, lr_scale
+                    )
+                    self._host_step += 1
+                else:
+                    metrics = self._eval_step(self.state.params, x, y, mask)
             pending.append((split, metrics))
-        for split, metrics in jax.device_get(pending):
-            self.decision.add_minibatch(
-                split, {k: float(v) for k, v in metrics.items()}
-            )
+        with self.timer.phase("metrics_sync"):
+            for split, metrics in jax.device_get(pending):
+                self.decision.add_minibatch(
+                    split, {k: float(v) for k, v in metrics.items()}
+                )
         verdict = self.decision.on_epoch_end()
         if self.snapshotter is not None:
             self.snapshotter.maybe_save(
@@ -215,6 +225,50 @@ class Workflow(Logger):
                     "service %s failed", type(service).__name__
                 )
         return verdict
+
+    def evaluate(self, split: str = "test", *, confusion: bool = False):
+        """Standalone evaluation pass over one split.
+
+        Returns {"loss", "n_err", "err_pct", "n_samples"} plus a summed
+        ``confusion`` matrix (rows = truth) when requested — the reference
+        EvaluatorSoftmax's full metric set (SURVEY.md 2.3).
+        """
+        if self.state is None:
+            self.initialize()
+        from znicz_tpu.nn import evaluator as eval_mod
+
+        n_err = 0.0
+        loss_sum = 0.0
+        n = 0.0
+        conf = None
+        # shuffle=False: evaluation is read-only — it must not advance the
+        # loader's shuffle stream (resume determinism)
+        for mb in self.loader.batches(split, shuffle=False):
+            x = jnp.asarray(mb.data)
+            y = self._batch_target(mb)
+            mask = jnp.asarray(mb.mask)
+            if self.loss_function == "softmax" and confusion:
+                out = self.model.apply(self.state.params, x, train=False)
+                m = eval_mod.softmax(
+                    out, y, mask=mask, compute_confusion=True
+                )
+                c = np.asarray(m["confusion"])
+                conf = c if conf is None else conf + c
+            else:
+                m = self._eval_step(self.state.params, x, y, mask)
+            k = float(m["n_samples"])
+            n += k
+            n_err += float(m.get("n_err", 0.0))
+            loss_sum += float(m["loss"]) * k
+        result = {
+            "n_samples": n,
+            "n_err": n_err,
+            "err_pct": 100.0 * n_err / max(n, 1.0),
+            "loss": loss_sum / max(n, 1.0),
+        }
+        if conf is not None:
+            result["confusion"] = conf
+        return result
 
     def run(self) -> Decision:
         """Train until the Decision stops; returns it (history, best)."""
