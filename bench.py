@@ -139,10 +139,19 @@ def main() -> None:
         m_short, m_long = mnist_timed(300), mnist_timed(900)
         if m_long > m_short:
             estimates.append((m_long - m_short) / 600 * 1000)
-    if estimates:
-        mnist_step_ms = sorted(estimates)[len(estimates) // 2]
+    if len(estimates) == 3:
+        mnist_step_ms = sorted(estimates)[1]
+    elif len(estimates) == 2:  # sorted[1] of two would pick the larger
+        mnist_step_ms = sum(estimates) / 2
+    elif estimates:
+        mnist_step_ms = estimates[0]
     else:
         mnist_step_ms = mnist_timed(900) / 900 * 1000
+    if len(estimates) < 3:
+        print(
+            f"mnist timing: {3 - len(estimates)} noisy pair(s) dropped",
+            file=sys.stderr,
+        )
     fwd_flops = _model_flops_per_image(
         root.alexnet.get("layers"), wf.loader.sample_shape
     )

@@ -47,15 +47,33 @@ class TestGatherRows:
             out, data[idx].astype(np.float32) / 255.0 - 0.5, atol=1e-6
         )
 
-    def test_used_by_fullbatch_loader(self, native_available):
+    def test_fullbatch_f32_path_is_plain_numpy(self):
+        # the f32 path deliberately does NOT use the native lib (no win);
+        # this guards the plain-indexing behavior
         from znicz_tpu.loader import FullBatchLoader
 
         x = np.arange(40, dtype=np.float32).reshape(10, 4)
         ld = FullBatchLoader(
             {"train": x}, minibatch_size=4, shuffle=False
         )
+        assert not ld._lazy_u8
         mb = next(iter(ld.batches("train")))
         np.testing.assert_array_equal(mb.data, x[:4])
+
+    def test_out_of_range_indices_raise(self):
+        # validated on BOTH paths (native and numpy fallback): no silent
+        # negative-index wrapping anywhere
+        data = np.zeros((4, 3), np.float32)
+        with pytest.raises(IndexError):
+            native.gather_rows(data, np.array([4]))
+        with pytest.raises(IndexError):
+            native.gather_rows(data, np.array([-1]))
+        u8 = np.zeros((4, 3), np.uint8)
+        with pytest.raises(IndexError):
+            native.gather_rows_u8(u8, np.array([9]))
+        # fallback dtype (f64) also validates
+        with pytest.raises(IndexError):
+            native.gather_rows(data.astype(np.float64), np.array([-1]))
 
     def test_fullbatch_lazy_u8_path(self):
         # u8 data + range normalization: dataset stays u8 in memory and

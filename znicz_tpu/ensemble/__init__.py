@@ -96,7 +96,8 @@ class Ensemble(Logger):
         """
         loader = self.workflows[0].loader
         n_err, n, member_errs = 0, 0, np.zeros(len(self.workflows))
-        for mb in loader.batches(split):
+        # shuffle=False: evaluation must not advance the shuffle PRNG stream
+        for mb in loader.batches(split, shuffle=False):
             valid = mb.mask > 0
             labels = mb.labels[valid]
             probs = [

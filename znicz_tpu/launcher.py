@@ -162,10 +162,17 @@ def run_args(argv=None) -> Launcher:
         export_path, args.export = args.export, None
         if export_path:
             # exportability must fail BEFORE a long search, not after it:
-            # probe with a dry run (builds the workflow, trains nothing)
+            # probe with a dry run (builds the workflow, trains nothing);
+            # restore the PRNG registry afterwards so the search trajectory
+            # is identical with and without --export
+            from znicz_tpu.core import prng as _prng
+
+            prng_state = _prng.state_dict()
             args.export, args.dry_run, saved_dry = export_path, True, args.dry_run
             module.run(launcher.load, launcher.main)
             args.export, args.dry_run = None, saved_dry
+            _prng.reset()
+            _prng.load_state_dict(prng_state)
         launcher.result = optimize_workflow(
             module, launcher, generations=args.optimize
         )

@@ -106,17 +106,26 @@ def mnist(
                     data[split] = _read_idx(ip)
                     labels[split] = _read_idx(lp).astype(np.int32)
                     break
-        if data:
-            loader_kwargs.setdefault("normalization", "range")
-            loader_kwargs.setdefault(
-                "normalization_kwargs", {"scale": 255.0, "shift": -0.5}
-            )
         if set(data) not in (set(), {"train", "test"}):
             raise FileNotFoundError(
                 f"{data_dir} holds only the {sorted(data)} MNIST split(s); "
                 "need both train-* and t10k-* IDX files (or none, for the "
                 "synthetic stand-in)"
             )
+        if data:
+            if "normalization" in loader_kwargs:
+                # caller chose a normalization in the [-0.5, 0.5] units the
+                # f32 path always produced: convert eagerly; u8 storage is
+                # only for the default (range) path
+                data = {
+                    k: v.astype(np.float32) / 255.0 - 0.5
+                    for k, v in data.items()
+                }
+            else:
+                loader_kwargs["normalization"] = "range"
+                loader_kwargs["normalization_kwargs"] = {
+                    "scale": 255.0, "shift": -0.5,
+                }
     if not data:
         data, labels = _synthetic_split(n_train, n_test, (28, 28), 10)
     if validation_ratio > 0:
@@ -170,10 +179,17 @@ def cifar10(
         if all(os.path.exists(p) for p in batch_paths + [test_path]):
             data["train"], labels["train"] = _load_batches(batch_paths)
             data["test"], labels["test"] = _load_batches([test_path])
-            loader_kwargs.setdefault("normalization", "range")
-            loader_kwargs.setdefault(
-                "normalization_kwargs", {"scale": 255.0, "shift": -0.5}
-            )
+            if "normalization" in loader_kwargs:
+                # caller's normalization expects the legacy [-0.5, 0.5] units
+                data = {
+                    k: v.astype(np.float32) / 255.0 - 0.5
+                    for k, v in data.items()
+                }
+            else:
+                loader_kwargs["normalization"] = "range"
+                loader_kwargs["normalization_kwargs"] = {
+                    "scale": 255.0, "shift": -0.5,
+                }
             loaded = True
     if not loaded:
         data, labels = _synthetic_split(n_train, n_test, (32, 32, 3), 10)

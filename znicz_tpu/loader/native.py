@@ -82,18 +82,29 @@ def available() -> bool:
     return _build_and_load() is not None
 
 
+def _check_indices(indices: np.ndarray, n: int) -> np.ndarray:
+    """The C side does raw pointer math: reject what numpy would reject
+    (and the negatives numpy would wrap) BEFORE crossing the ABI."""
+    idx = np.ascontiguousarray(indices, np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= n):
+        raise IndexError(
+            f"indices out of range [0, {n}): min={idx.min()} max={idx.max()}"
+        )
+    return idx
+
+
 def gather_rows(data: np.ndarray, indices: np.ndarray) -> np.ndarray:
     """out[i] = data[indices[i]] — native parallel gather with numpy
     fallback.  ``data``: [n, ...] float32 C-contiguous."""
     lib = _build_and_load()
     flat = data.reshape(len(data), -1)
+    idx = _check_indices(indices, len(data))  # both paths: no numpy wrap
     if (
         lib is None
         or flat.dtype != np.float32
         or not flat.flags["C_CONTIGUOUS"]
     ):
-        return data[indices]
-    idx = np.ascontiguousarray(indices, np.int64)
+        return data[idx]
     out = np.empty((len(idx), flat.shape[1]), np.float32)
     lib.gather_rows_f32(flat, flat.shape[1], idx, len(idx), out)
     return out.reshape((len(idx),) + data.shape[1:])
@@ -109,15 +120,15 @@ def gather_rows_u8(
     """Gather + u8->f32 affine normalize in one native pass."""
     lib = _build_and_load()
     flat = data.reshape(len(data), -1)
+    idx = _check_indices(indices, len(data))  # both paths: no numpy wrap
     if (
         lib is None
         or flat.dtype != np.uint8
         or not flat.flags["C_CONTIGUOUS"]
     ):
         return (
-            data[indices].astype(np.float32) / scale + shift
+            data[idx].astype(np.float32) / scale + shift
         )
-    idx = np.ascontiguousarray(indices, np.int64)
     out = np.empty((len(idx), flat.shape[1]), np.float32)
     lib.gather_rows_u8_normalize(
         flat, flat.shape[1], idx, len(idx), scale, shift, out

@@ -1,0 +1,76 @@
+"""Background-thread minibatch prefetching.
+
+The reference hid loader latency behind its event-driven thread pool (the
+loader unit ran concurrently with device units, SURVEY.md 1 L4); the rebuilt
+hot loop is a single host thread, so decode/gather work (image files, u8
+conversion) would serialize with device dispatch.  ``prefetch`` runs the
+loader's generator in a worker thread with a small bounded queue — identical
+yield order and PRNG draw sequence, overlapped with compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_SENTINEL = object()
+
+
+def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Yield from ``iterable``, produced ``depth`` items ahead in a thread.
+
+    Exceptions in the producer re-raise at the consumer's next pull.  If the
+    consumer abandons the iterator (exception mid-epoch, interrupt), closing
+    the generator signals the worker to stop — no thread or queued batches
+    leak.
+    """
+    q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+    stop = threading.Event()
+    error: list = []
+
+    def worker():
+        try:
+            for item in iterable:
+                # bounded put that gives up when the consumer went away
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — must cross threads
+            error.append(e)
+        finally:
+            # deliver the sentinel with the same give-up-on-stop loop as
+            # items: a fixed timeout would lose it when the consumer stalls
+            # longer (e.g. first-step XLA compile) and deadlock the epoch
+            while not stop.is_set():
+                try:
+                    q.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if error:
+                    raise error[0]
+                return
+            yield item
+    finally:
+        # runs on normal exhaustion AND on generator close/abandonment
+        stop.set()
+        while True:  # unblock a worker stuck in put()
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

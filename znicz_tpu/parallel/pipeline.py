@@ -1,0 +1,155 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline.
+
+NOT in the reference (SURVEY.md 2.5 lists pipeline parallel as absent) — a
+new capability completing the DP/TP/SP set.  TPU-native formulation: S
+identical-shaped stages are STACKED (params carry a leading stage dim) and
+sharded over the mesh's ``pipe`` axis; microbatches flow through the ring
+via ``ppermute`` while every device runs the same program (SPMD — no
+per-stage programs, which is what makes this jit/XLA-friendly).
+
+Schedule: at tick t (t = 0 .. S+M-2), the device holding stage s computes
+microbatch (t - s) when 0 <= t - s < M, then activations rotate one hop
+forward.  Autodiff through the whole shard_map gives the backward pipeline
+for free (reverse ppermutes appear in the transpose).
+
+Constraint: all stages share one signature/shape — the classic stacked-layer
+pipeline (e.g. a tower of identical FC or transformer blocks).  Embedding /
+head layers run outside the pipelined tower.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """[{...}, {...}, ...] (same shapes) -> one pytree with leading S dim."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )
+
+
+def _local_pipeline(params, x, *, apply_one, axis_name, n_micro):
+    """shard_map body: params [1, ...] (this device's stage), x [M, mb, F]
+    replicated microbatches; returns final activations [M, mb, F]."""
+    s_idx = jax.lax.axis_index(axis_name)
+    n_stages = jax.lax.psum(1, axis_name)
+    stage_params = jax.tree_util.tree_map(lambda p: p[0], params)
+
+    mb_shape = x.shape[1:]
+    # each device's working buffer: current activation in flight
+    def tick(t, carry):
+        buf, outputs = carry
+        my_micro = t - s_idx  # which microbatch this device would process
+        active = (my_micro >= 0) & (my_micro < n_micro)
+        # stage input: first stage reads the raw microbatch, others read buf
+        micro_in = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(my_micro, 0, n_micro - 1), keepdims=False
+        )
+        stage_in = jnp.where(s_idx == 0, micro_in, buf)
+        out = apply_one(stage_params, stage_in)
+        out = jnp.where(active, out, buf)
+        # last stage stores its finished microbatch
+        is_last = s_idx == n_stages - 1
+        store_idx = jnp.clip(my_micro, 0, n_micro - 1)
+        outputs = jax.lax.cond(
+            active & is_last,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, out, store_idx, axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        # rotate activations one hop forward around the ring
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        buf = jax.lax.ppermute(out, axis_name, perm)
+        return buf, outputs
+
+    # pcast to varying: the loop mixes these with stage-dependent values
+    def varying(v):
+        return jax.lax.pcast(v, axis_name, to="varying")
+
+    buf0 = varying(jnp.zeros(mb_shape, x.dtype))
+    out0 = varying(jnp.zeros_like(x))
+    _, outputs = jax.lax.fori_loop(
+        0, n_stages + n_micro - 1, tick, (buf0, out0)
+    )
+    # every device returns the same [M, mb, F] buffer; only the last
+    # stage's is filled — broadcast it back around the ring
+    outputs = jax.lax.ppermute(
+        outputs,
+        axis_name,
+        [(j, (j + 1) % n_stages) for j in range(n_stages)],
+    )
+    # after one hop, device 0 holds the last stage's outputs; psum-select
+    outputs = jax.lax.psum(
+        jnp.where(jax.lax.axis_index(axis_name) == 0, outputs, 0.0),
+        axis_name,
+    )
+    return outputs
+
+
+def pipeline_apply(
+    stacked_params,
+    x: jnp.ndarray,
+    *,
+    apply_one: Callable,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis: str = PIPE_AXIS,
+) -> jnp.ndarray:
+    """Run x [B, F] through the stacked stages, pipelined over ``mesh[axis]``.
+
+    ``apply_one(stage_params, x_mb)`` applies ONE stage to one microbatch.
+    B must divide by ``n_microbatches``.
+    """
+    n_stages = mesh.shape[axis]
+    stage_dims = {
+        leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)
+    }
+    if stage_dims != {n_stages}:
+        raise ValueError(
+            f"stacked params have stage dim(s) {sorted(stage_dims)} but "
+            f"mesh axis {axis!r} has {n_stages} devices"
+        )
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by n_microbatches {n_microbatches}"
+        )
+    micro = x.reshape((n_microbatches, b // n_microbatches) + x.shape[1:])
+
+    def spec_for(leaf):
+        return P(axis, *([None] * (leaf.ndim - 1)))
+
+    param_specs = jax.tree_util.tree_map(spec_for, stacked_params)
+    fn = jax.shard_map(
+        partial(
+            _local_pipeline,
+            apply_one=apply_one,
+            axis_name=axis,
+            n_micro=n_microbatches,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, P()),  # stages sharded; microbatches replicated
+        out_specs=P(),
+    )
+    out = fn(stacked_params, micro)
+    return out.reshape((b,) + out.shape[2:])
+
+
+def shard_stacked_params(stacked_params, mesh: Mesh, axis: str = PIPE_AXIS):
+    """Place stacked stage params with the stage dim sharded over ``axis``."""
+
+    def place(leaf):
+        spec = P(axis, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, stacked_params)

@@ -37,7 +37,8 @@ class ImageSaver:
         if not hasattr(model, "predict") or workflow.loss_function != "softmax":
             return
         xs, probs, labels = [], [], []
-        for mb in workflow.loader.batches(self.split):
+        # shuffle=False: a service pass must not advance the shuffle stream
+        for mb in workflow.loader.batches(self.split, shuffle=False):
             p = np.asarray(model.predict(workflow.state.params, mb.data))
             valid = mb.mask > 0
             xs.append(np.asarray(mb.data)[valid])

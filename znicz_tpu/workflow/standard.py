@@ -49,6 +49,7 @@ class StandardWorkflow(Workflow):
         lr_policy: Optional[Dict[str, Any]] = None,
         default_hyper: Optional[Dict[str, Any]] = None,
         compute_dtype: Optional[Any] = None,
+        prefetch_batches: int = 2,
         rand_name: str = "default",
         name: str = "StandardWorkflow",
     ):
@@ -89,5 +90,6 @@ class StandardWorkflow(Workflow):
             decision=decision,
             snapshotter=snapshotter,
             lr_policy=policy,
+            prefetch_batches=prefetch_batches,
             name=name,
         )

@@ -49,6 +49,7 @@ class Workflow(Logger):
         snapshotter: Optional[Snapshotter] = None,
         lr_policy: Optional[Callable[[float, int], float]] = None,
         parallel=None,
+        prefetch_batches: int = 2,
         name: str = "workflow",
     ):
         self.loader = loader
@@ -61,11 +62,13 @@ class Workflow(Logger):
         self.snapshotter = snapshotter
         self.lr_policy = lr_policy
         self.parallel = parallel  # DataParallel placement policy, or None
+        self.prefetch_batches = prefetch_batches  # 0 disables the loader thread
         self.services = []  # per-epoch observers: plotters, status, image saver
         self.name = name
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._eval_step = None
+        self._eval_conf_step = None
         self._host_step = 0
         from znicz_tpu.utils.profiling import StepTimer
 
@@ -117,6 +120,16 @@ class Workflow(Logger):
 
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
         self._eval_step = jax.jit(eval_step)
+        if self.loss_function == "softmax":
+            from znicz_tpu.nn import evaluator as _ev
+
+            def eval_conf_step(params, x, y, mask):
+                out = model.apply(params, x, train=False)
+                return _ev.softmax(out, y, mask=mask, compute_confusion=True)
+
+            self._eval_conf_step = jax.jit(eval_conf_step)
+        else:
+            self._eval_conf_step = None
 
     # ------------------------------------------------------------------
     def initialize(
@@ -180,7 +193,12 @@ class Workflow(Logger):
         put = (
             self.parallel.shard_batch if self.parallel is not None else jnp.asarray
         )
-        for split, mb in self.loader.epoch():
+        epoch_iter = self.loader.epoch()
+        if self.prefetch_batches:
+            from znicz_tpu.loader.prefetch import prefetch
+
+            epoch_iter = prefetch(epoch_iter, self.prefetch_batches)
+        for split, mb in epoch_iter:
             with self.timer.phase(f"dispatch/{split}"):
                 x = put(mb.data)
                 # autoencoder target IS the input: reuse the device array
@@ -235,27 +253,33 @@ class Workflow(Logger):
         """
         if self.state is None:
             self.initialize()
-        from znicz_tpu.nn import evaluator as eval_mod
-
         n_err = 0.0
         loss_sum = 0.0
         n = 0.0
         conf = None
+        use_conf = (
+            confusion
+            and self.loss_function == "softmax"
+            and self._eval_conf_step is not None
+        )
         # shuffle=False: evaluation is read-only — it must not advance the
         # loader's shuffle stream (resume determinism)
+        put = (
+            self.parallel.shard_batch
+            if self.parallel is not None
+            else jnp.asarray
+        )
+        pending = []
         for mb in self.loader.batches(split, shuffle=False):
-            x = jnp.asarray(mb.data)
-            y = self._batch_target(mb)
-            mask = jnp.asarray(mb.mask)
-            if self.loss_function == "softmax" and confusion:
-                out = self.model.apply(self.state.params, x, train=False)
-                m = eval_mod.softmax(
-                    out, y, mask=mask, compute_confusion=True
-                )
+            x = put(mb.data)
+            y = put(self._batch_target(mb))
+            mask = put(mb.mask)
+            step = self._eval_conf_step if use_conf else self._eval_step
+            pending.append(step(self.state.params, x, y, mask))
+        for m in jax.device_get(pending):  # one sync for the whole split
+            if use_conf:
                 c = np.asarray(m["confusion"])
                 conf = c if conf is None else conf + c
-            else:
-                m = self._eval_step(self.state.params, x, y, mask)
             k = float(m["n_samples"])
             n += k
             n_err += float(m.get("n_err", 0.0))
