@@ -12,3 +12,35 @@ __version__ = "0.1.0"
 from znicz_tpu.core.config import Config, root  # noqa: F401
 from znicz_tpu.core import prng  # noqa: F401
 from znicz_tpu.core.logger import Logger  # noqa: F401
+
+
+# Lazy top-level API (PEP 562): keeps the heavyweight subsystems (workflow,
+# parallel, services) out of a bare `import znicz_tpu`.
+_LAZY = {
+    "Workflow": ("znicz_tpu.workflow", "Workflow"),
+    "StandardWorkflow": ("znicz_tpu.workflow", "StandardWorkflow"),
+    "KohonenWorkflow": ("znicz_tpu.workflow", "KohonenWorkflow"),
+    "RBMWorkflow": ("znicz_tpu.workflow", "RBMWorkflow"),
+    "Snapshotter": ("znicz_tpu.workflow", "Snapshotter"),
+    "FullBatchLoader": ("znicz_tpu.loader", "FullBatchLoader"),
+    "ImageDirectoryLoader": ("znicz_tpu.loader", "ImageDirectoryLoader"),
+    "DataParallel": ("znicz_tpu.parallel", "DataParallel"),
+    "make_mesh": ("znicz_tpu.parallel", "make_mesh"),
+    "Ensemble": ("znicz_tpu.ensemble", "Ensemble"),
+    "export_model": ("znicz_tpu.export", "export_model"),
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY:
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value  # cache: next access is a plain lookup
+        return value
+    raise AttributeError(f"module 'znicz_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))

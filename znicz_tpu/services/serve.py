@@ -1,0 +1,31 @@
+"""``python -m znicz_tpu.services.serve <dir> [port]`` — serve a status
+directory over HTTP.
+
+The reference runs a live tornado dashboard inside the training process
+(``veles/web_status.py``, SURVEY.md 2.1); here serving is decoupled: training
+writes ``status.json``/``status.html`` files (StatusWriter) and this command
+— or any web server — exposes them.  Any number of viewers, zero
+training-side state.
+"""
+
+from __future__ import annotations
+
+import functools
+import http.server
+import sys
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    directory = args[0] if args else "."
+    port = int(args[1]) if len(args) > 1 else 8080
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=directory
+    )
+    print(f"serving {directory} at http://localhost:{port}/status.html")
+    http.server.ThreadingHTTPServer(("", port), handler).serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -272,7 +272,7 @@ class Workflow(Logger):
         pending = []
         for mb in self.loader.batches(split, shuffle=False):
             x = put(mb.data)
-            y = put(self._batch_target(mb))
+            y = x if self.target == "input" else put(self._batch_target(mb))
             mask = put(mb.mask)
             step = self._eval_conf_step if use_conf else self._eval_step
             pending.append(step(self.state.params, x, y, mask))
