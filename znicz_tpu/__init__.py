@@ -21,6 +21,7 @@ _LAZY = {
     "StandardWorkflow": ("znicz_tpu.workflow", "StandardWorkflow"),
     "KohonenWorkflow": ("znicz_tpu.workflow", "KohonenWorkflow"),
     "RBMWorkflow": ("znicz_tpu.workflow", "RBMWorkflow"),
+    "TransformerLMWorkflow": ("znicz_tpu.workflow", "TransformerLMWorkflow"),
     "Snapshotter": ("znicz_tpu.workflow", "Snapshotter"),
     "FullBatchLoader": ("znicz_tpu.loader", "FullBatchLoader"),
     "ImageDirectoryLoader": ("znicz_tpu.loader", "ImageDirectoryLoader"),

@@ -35,7 +35,17 @@ class FullBatchLoader(Loader):
         **kwargs,
     ):
         super().__init__(**kwargs)
-        self.data = {k: np.asarray(v) for k, v in data.items() if v is not None}
+        # zero-length splits are simply absent (reshape/normalize of empty
+        # arrays has no meaning and callers build sizes from configs)
+        self.data = {
+            k: np.asarray(v)
+            for k, v in data.items()
+            if v is not None and len(v)
+        }
+        if not self.data:
+            raise ValueError(
+                "FullBatchLoader needs at least one non-empty split"
+            )
         self.labels = {
             k: np.asarray(v, np.int32)
             for k, v in (labels or {}).items()

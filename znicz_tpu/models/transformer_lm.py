@@ -1,0 +1,85 @@
+"""Causal transformer language model sample.
+
+NOT in the reference model zoo (pre-transformer framework) — the long-context
+showcase: a small causal LM trained on synthetic bigram-structured token
+sequences, whose loss floor is the bigram entropy (so convergence is
+measurable without any dataset on disk).  ``sequence_parallel=True`` swaps in
+ring attention over a device mesh.
+"""
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import FullBatchLoader
+from znicz_tpu.models import effective_config, merge_workflow_kwargs
+from znicz_tpu.workflow.transformer import TransformerLMWorkflow
+
+DEFAULTS = {
+    "loader": {
+        "n_train": 512,
+        "n_test": 128,
+        "seq_len": 64,
+        "minibatch_size": 64,
+    },
+    "vocab": 32,
+    "d_model": 64,
+    "n_layers": 2,
+    "n_heads": 4,
+    "max_epochs": 15,
+}
+root.transformer_lm.update(DEFAULTS)
+
+
+def _bigram_chain(vocab: int) -> np.ndarray:
+    """One fixed random bigram transition matrix — train AND test must come
+    from the same language or test loss is meaningless."""
+    gen = prng.get("datasets")
+    logits = gen.normal((vocab, vocab), 0.0, 2.0)
+    return np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+
+
+def _bigram_sequences(probs: np.ndarray, n: int, t: int) -> np.ndarray:
+    gen = prng.get("datasets")
+    vocab = probs.shape[0]
+    out = np.zeros((n, t), np.int32)
+    out[:, 0] = gen.integers(0, vocab, (n,))
+    for i in range(1, t):
+        u = gen.uniform((n,), 0.0, 1.0)
+        cdf = probs[out[:, i - 1]].cumsum(axis=1)
+        out[:, i] = (u[:, None] > cdf).sum(axis=1)
+    return out
+
+
+def build_workflow(**overrides) -> TransformerLMWorkflow:
+    cfg = effective_config(root.transformer_lm, DEFAULTS)
+    lcfg = cfg.loader
+    vocab = cfg.get("vocab", 32)
+    t = lcfg.get("seq_len", 64)
+    chain = _bigram_chain(vocab)
+    train = _bigram_sequences(chain, lcfg.get("n_train", 512), t)
+    test = _bigram_sequences(chain, lcfg.get("n_test", 128), t)
+    loader = FullBatchLoader(
+        {"train": train, "test": test},
+        minibatch_size=lcfg.get("minibatch_size", 64),
+    )
+    kwargs = merge_workflow_kwargs(
+        {
+            "vocab": vocab,
+            "d_model": cfg.get("d_model", 64),
+            "n_layers": cfg.get("n_layers", 2),
+            "n_heads": cfg.get("n_heads", 4),
+            "max_epochs": cfg.get("max_epochs", 15),
+            "name": "TransformerLMWorkflow",
+        },
+        overrides,
+    )
+    from znicz_tpu.models import translate_unsupervised_overrides
+
+    kwargs = translate_unsupervised_overrides(kwargs, "max_epochs")
+    return TransformerLMWorkflow(loader, **kwargs)
+
+
+def run(load, main):
+    load(build_workflow)
+    main()

@@ -13,6 +13,7 @@ Pallas kernel under ``znicz_tpu/ops/pallas/``.  Backward is autodiff.
 
 from __future__ import annotations
 
+import jax
 import jax.lax as lax
 import jax.numpy as jnp
 
@@ -61,3 +62,18 @@ def lrn(
         return pallas_lrn.lrn(x, alpha, beta, k, n)
     sums = _window_sums(jnp.square(x), n)
     return x * jnp.power(k + alpha * sums, -beta)
+
+
+def layer_norm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    *,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    """Layer normalization over the trailing feature axis (transformer
+    building block; not in the reference, which predates it)."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * scale + bias

@@ -16,14 +16,17 @@ import sys
 
 
 def main(argv=None) -> int:
+    """Usage: serve <dir> [port] [host].  Binds loopback by default —
+    serving all interfaces (host 0.0.0.0) is an explicit choice."""
     args = list(sys.argv[1:] if argv is None else argv)
     directory = args[0] if args else "."
     port = int(args[1]) if len(args) > 1 else 8080
+    host = args[2] if len(args) > 2 else "127.0.0.1"
     handler = functools.partial(
         http.server.SimpleHTTPRequestHandler, directory=directory
     )
-    print(f"serving {directory} at http://localhost:{port}/status.html")
-    http.server.ThreadingHTTPServer(("", port), handler).serve_forever()
+    print(f"serving {directory} at http://{host}:{port}/status.html")
+    http.server.ThreadingHTTPServer((host, port), handler).serve_forever()
     return 0
 
 

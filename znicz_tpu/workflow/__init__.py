@@ -16,3 +16,6 @@ from znicz_tpu.workflow.unsupervised import (  # noqa: F401
     KohonenWorkflow,
     RBMWorkflow,
 )
+from znicz_tpu.workflow.transformer import (  # noqa: F401
+    TransformerLMWorkflow,
+)

@@ -1,0 +1,244 @@
+"""Causal transformer language-model workflow.
+
+NOT in the reference (VELES predates transformers, SURVEY.md 5.7) — this is
+the workflow that makes the long-context stack user-facing: the attention op
+(:mod:`znicz_tpu.ops.attention`), optional ring-attention sequence
+parallelism (:mod:`znicz_tpu.parallel.ring_attention`), layer norm, and the
+standard loader/decision/snapshotter machinery, trained with next-token
+cross-entropy under the same momentum-SGD update rule as every other
+workflow.
+
+Params are a list of flat per-layer dicts so the optimizer's per-layer
+HyperParams and ``*_bias`` multiplier rules apply unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader.base import Loader
+from znicz_tpu.nn import optimizer
+from znicz_tpu.nn.decision import Decision
+from znicz_tpu.nn.train_state import TrainState
+from znicz_tpu.ops import attention
+from znicz_tpu.ops.filling import fill
+from znicz_tpu.ops.normalization import layer_norm
+from znicz_tpu.workflow.snapshotter import Snapshotter
+from znicz_tpu.workflow.workflow import Workflow
+
+
+def init_lm_params(
+    vocab: int,
+    d_model: int,
+    n_layers: int,
+    n_heads: int,
+    max_seq: int,
+    *,
+    d_ff: Optional[int] = None,
+    rand_name: str = "default",
+):
+    """[embed, block_0, ..., block_{L-1}, head] — flat dicts per layer."""
+    gen = prng.get(rand_name)
+    d_ff = d_ff or 4 * d_model
+    std = 1.0 / np.sqrt(d_model)
+    params = [
+        {
+            "embed": jnp.asarray(fill(gen, (vocab, d_model), "gaussian", std)),
+            "pos": jnp.asarray(fill(gen, (max_seq, d_model), "gaussian", std)),
+        }
+    ]
+    for _ in range(n_layers):
+        block = {
+            "ln1_scale": jnp.ones((d_model,)),
+            "ln1_bias": jnp.zeros((d_model,)),
+            "ln2_scale": jnp.ones((d_model,)),
+            "ln2_bias": jnp.zeros((d_model,)),
+            # names end in "bias" so HyperParams' *_bias multiplier rules
+            # classify them like every other workflow's biases
+            "w_up": jnp.asarray(fill(gen, (d_model, d_ff), "gaussian", std)),
+            "up_bias": jnp.zeros((d_ff,)),
+            "w_down": jnp.asarray(
+                fill(gen, (d_ff, d_model), "gaussian", 1.0 / np.sqrt(d_ff))
+            ),
+            "down_bias": jnp.zeros((d_model,)),
+        }
+        block.update(
+            attention.init_mha_params(
+                d_model, n_heads, rand_name=rand_name
+            )
+        )
+        params.append(block)
+    params.append(
+        {"head": jnp.asarray(fill(gen, (d_model, vocab), "gaussian", std))}
+    )
+    return params
+
+
+def lm_apply(params, tokens, *, n_heads, attention_fn=None):
+    """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    attention_fn = attention_fn or attention.dot_product_attention
+    embed = params[0]
+    t = tokens.shape[1]
+    x = embed["embed"][tokens] + embed["pos"][:t][None, :, :]
+    for block in params[1:-1]:
+        h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
+        x = x + attention.mha(
+            block, h, n_heads=n_heads, causal=True,
+            attention_fn=attention_fn,
+        )
+        h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
+        h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
+        x = x + h @ block["w_down"] + block["down_bias"]
+    return x @ params[-1]["head"]
+
+
+class TransformerLMWorkflow(Workflow):
+    """Next-token LM training over integer-sequence loaders.
+
+    Loader contract: ``data[split]`` is [N, T] integer tokens (stored as any
+    numeric dtype); the per-sample ``mask`` marks valid rows as usual.
+
+    ``sequence_parallel``: shard the sequence axis over a mesh's data axis
+    with ring attention (set ``parallel`` too for the batch placement).
+    """
+
+    def __init__(
+        self,
+        loader: Loader,
+        *,
+        vocab: int,
+        d_model: int = 64,
+        n_layers: int = 2,
+        n_heads: int = 4,
+        max_epochs: int = 10,
+        hyper: Optional[optimizer.HyperParams] = None,
+        sequence_parallel: bool = False,
+        mesh=None,
+        decision: Optional[Decision] = None,
+        snapshotter: Optional[Snapshotter] = None,
+        lr_policy=None,
+        parallel=None,
+        prefetch_batches: int = 2,
+        rand_name: str = "default",
+        name: str = "TransformerLMWorkflow",
+    ):
+        class _LM:
+            params: list = []
+            hyper: list = []
+
+        super().__init__(
+            loader,
+            _LM(),
+            loss_function="mse",  # metric label only; we override the step
+            target="labels",
+            decision=decision or Decision(metric="loss", max_epochs=max_epochs),
+            snapshotter=snapshotter,
+            lr_policy=lr_policy,
+            parallel=parallel,
+            prefetch_batches=prefetch_batches,
+            name=name,
+        )
+        self.vocab = vocab
+        self.d_model = d_model
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.hyper = hyper or optimizer.HyperParams(
+            learning_rate=0.1, gradient_moment=0.9
+        )
+        self.rand_name = rand_name
+        self.sequence_parallel = sequence_parallel
+        self.mesh = mesh
+        self.max_seq = int(loader.sample_shape[0])
+
+    def _batch_target(self, mb):
+        return jnp.zeros((len(mb.mask),), jnp.int32)  # unused
+
+    def _attention_fn(self):
+        if not self.sequence_parallel:
+            return None
+        from znicz_tpu.parallel.ring_attention import ring_attention
+
+        return partial(ring_attention, mesh=self.mesh)
+
+    def _build_steps(self):
+        n_heads = self.n_heads
+        attention_fn = self._attention_fn()
+
+        def loss_metrics(params, tokens, mask):
+            tokens = tokens.astype(jnp.int32)
+            logits = lm_apply(
+                params, tokens, n_heads=n_heads, attention_fn=attention_fn
+            )
+            # next-token CE: predict tokens[:, 1:] from positions [:-1]
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            tgt = tokens[:, 1:]
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            per_sample = jnp.mean(nll, axis=1)  # [B]
+            n_valid = jnp.maximum(jnp.sum(mask), 1.0)
+            loss = jnp.sum(per_sample * mask) / n_valid
+            pred = jnp.argmax(logp, axis=-1)
+            acc = jnp.sum(
+                jnp.mean((pred == tgt).astype(jnp.float32), axis=1) * mask
+            ) / n_valid
+            return loss, {
+                "loss": loss,
+                "n_samples": n_valid,
+                "n_err": jnp.zeros((), jnp.int32),
+                "token_accuracy": acc,
+            }
+
+        def train_step(state: TrainState, x, y, mask, lr_scale):
+            grads, metrics = jax.grad(loss_metrics, has_aux=True)(
+                state.params, x, mask
+            )
+            hyper = self.hyper._replace(
+                learning_rate=self.hyper.learning_rate * lr_scale,
+                learning_rate_bias=(
+                    None
+                    if self.hyper.learning_rate_bias is None
+                    else self.hyper.learning_rate_bias * lr_scale
+                ),
+            )
+            new_p, new_v = optimizer.update(
+                state.params, grads, state.velocity, hyper
+            )
+            return (
+                state._replace(
+                    params=new_p, velocity=new_v, step=state.step + 1
+                ),
+                metrics,
+            )
+
+        def eval_step(params, x, y, mask):
+            _, metrics = loss_metrics(params, x, mask)
+            return metrics
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step)
+        self._eval_conf_step = None
+
+    def initialize(self, *, seed=None, snapshot=None):
+        if seed is not None:
+            prng.seed_all(seed)
+        if snapshot:
+            return Workflow.initialize(self, seed=None, snapshot=snapshot)
+        if self.state is None:
+            params = init_lm_params(
+                self.vocab,
+                self.d_model,
+                self.n_layers,
+                self.n_heads,
+                self.max_seq,
+                rand_name=self.rand_name,
+            )
+            self.state = TrainState.create(params, prng.get("workflow").key())
+        if self.parallel is not None:
+            self.state = self.parallel.shard_state(self.state)
+        self._host_step = int(self.state.step)
+        self._build_steps()
