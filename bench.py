@@ -58,7 +58,7 @@ def main() -> None:
     from znicz_tpu.core.config import root
     from znicz_tpu.models import alexnet
 
-    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     root.alexnet.loader.update(
         {"minibatch_size": batch, "n_train": batch, "n_valid": 0}

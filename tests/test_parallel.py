@@ -89,6 +89,43 @@ class TestDataParallelTraining:
         assert wf.state.params[0]["weights"].is_fully_replicated
 
 
+class TestUnsupervisedDataParallel:
+    def test_kohonen_dp_matches_single_device(self):
+        from znicz_tpu.workflow import KohonenWorkflow
+
+        def build(parallel):
+            prng.seed_all(31)
+            loader = datasets.mnist(
+                n_train=128, n_test=0, minibatch_size=64,
+                normalization="mean_disp",
+            )
+            wf = KohonenWorkflow(
+                loader, sx=4, sy=4, total_epochs=2, parallel=parallel
+            )
+            wf.initialize(seed=31)
+            return wf.run().history
+
+        a = build(None)
+        b = build(DataParallel(make_mesh(8, 1)))
+        for ea, eb in zip(a, b):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"], rtol=1e-4
+            )
+
+    def test_rbm_dp_runs(self):
+        from znicz_tpu.workflow import RBMWorkflow
+
+        prng.seed_all(33)
+        loader = datasets.mnist(n_train=128, n_test=0, minibatch_size=64)
+        wf = RBMWorkflow(
+            loader, n_hidden=32, max_epochs=2,
+            parallel=DataParallel(make_mesh(8, 1)),
+        )
+        wf.initialize(seed=33)
+        dec = wf.run()
+        assert np.isfinite(dec.history[-1]["train"]["loss"])
+
+
 class TestGraftEntry:
     def test_dryrun_multichip_8(self):
         import importlib.util

@@ -91,9 +91,16 @@ class Launcher(Logger):
         if self.args.data_parallel and "parallel" not in wf_kwargs:
             from znicz_tpu.parallel import DataParallel
 
-            wf_kwargs = dict(wf_kwargs)
-            self.workflow = workflow_cls(*wf_args, **wf_kwargs)
-            self.workflow.parallel = DataParallel()
+            dp = DataParallel()
+            try:
+                self.workflow = workflow_cls(
+                    *wf_args, **{**wf_kwargs, "parallel": dp}
+                )
+            except TypeError:
+                # user workflows predating the kwarg: attribute assignment
+                # before initialize() has identical semantics
+                self.workflow = workflow_cls(*wf_args, **wf_kwargs)
+                self.workflow.parallel = dp
             return self.workflow
         self.workflow = workflow_cls(*wf_args, **wf_kwargs)
         return self.workflow

@@ -50,6 +50,7 @@ class StandardWorkflow(Workflow):
         default_hyper: Optional[Dict[str, Any]] = None,
         compute_dtype: Optional[Any] = None,
         prefetch_batches: int = 2,
+        parallel=None,
         rand_name: str = "default",
         name: str = "StandardWorkflow",
     ):
@@ -91,5 +92,6 @@ class StandardWorkflow(Workflow):
             snapshotter=snapshotter,
             lr_policy=policy,
             prefetch_batches=prefetch_batches,
+            parallel=parallel,
             name=name,
         )

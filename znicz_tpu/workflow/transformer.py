@@ -223,22 +223,13 @@ class TransformerLMWorkflow(Workflow):
         self._eval_step = jax.jit(eval_step)
         self._eval_conf_step = None
 
-    def initialize(self, *, seed=None, snapshot=None):
-        if seed is not None:
-            prng.seed_all(seed)
-        if snapshot:
-            return Workflow.initialize(self, seed=None, snapshot=snapshot)
-        if self.state is None:
-            params = init_lm_params(
-                self.vocab,
-                self.d_model,
-                self.n_layers,
-                self.n_heads,
-                self.max_seq,
-                rand_name=self.rand_name,
-            )
-            self.state = TrainState.create(params, prng.get("workflow").key())
-        if self.parallel is not None:
-            self.state = self.parallel.shard_state(self.state)
-        self._host_step = int(self.state.step)
-        self._build_steps()
+    def _create_initial_state(self) -> TrainState:
+        params = init_lm_params(
+            self.vocab,
+            self.d_model,
+            self.n_layers,
+            self.n_heads,
+            self.max_seq,
+            rand_name=self.rand_name,
+        )
+        return TrainState.create(params, prng.get("workflow").key())

@@ -50,6 +50,8 @@ class KohonenWorkflow(Workflow):
         sigma1: float = 1.0,
         decision: Optional[Decision] = None,
         snapshotter: Optional[Snapshotter] = None,
+        parallel=None,
+        prefetch_batches: int = 2,
         rand_name: str = "default",
         impl: str = "auto",  # "pallas" | "xla" | "auto" (pallas on TPU)
         name: str = "KohonenWorkflow",
@@ -62,6 +64,8 @@ class KohonenWorkflow(Workflow):
             decision=decision
             or Decision(metric="loss", max_epochs=total_epochs),
             snapshotter=snapshotter,
+            parallel=parallel,
+            prefetch_batches=prefetch_batches,
             name=name,
         )
         self.sx, self.sy = sx, sy
@@ -78,9 +82,14 @@ class KohonenWorkflow(Workflow):
         coords = kh.grid_coords(self.sx, self.sy)
         n_steps_per_epoch = max(self.loader.n_minibatches(TRAIN), 1)
         total_steps = self.total_epochs * n_steps_per_epoch
-        use_pallas = self.impl == "pallas" or (
-            self.impl == "auto"
-            and jax.default_backend() in ("tpu", "axon")
+        # the fused kernel has no partitioning rule: under a sharded batch
+        # (data parallel) the XLA composition is the correct path
+        use_pallas = self.parallel is None and (
+            self.impl == "pallas"
+            or (
+                self.impl == "auto"
+                and jax.default_backend() in ("tpu", "axon")
+            )
         )
         if use_pallas:
             from znicz_tpu.ops.pallas import kohonen as pallas_kh
@@ -136,20 +145,11 @@ class KohonenWorkflow(Workflow):
             "n_err": jnp.zeros((), jnp.int32),
         }
 
-    def initialize(self, *, seed=None, snapshot=None):
-        if seed is not None:
-            prng.seed_all(seed)
-        if self.state is None and not snapshot:
-            params = kh.init_params(
-                self.sx, self.sy, self._n_input, rand_name=self.rand_name
-            )
-            self.state = TrainState.create(
-                params, prng.get("workflow").key()
-            )
-        if snapshot:
-            return Workflow.initialize(self, seed=None, snapshot=snapshot)
-        self._host_step = int(self.state.step)
-        self._build_steps()
+    def _create_initial_state(self) -> TrainState:
+        params = kh.init_params(
+            self.sx, self.sy, self._n_input, rand_name=self.rand_name
+        )
+        return TrainState.create(params, prng.get("workflow").key())
 
     def weights_map(self):
         """[sy, sx, features] view of the trained map (for plotting)."""
@@ -175,6 +175,8 @@ class RBMWorkflow(Workflow):
         max_epochs: int = 20,
         decision: Optional[Decision] = None,
         snapshotter: Optional[Snapshotter] = None,
+        parallel=None,
+        prefetch_batches: int = 2,
         rand_name: str = "default",
         name: str = "RBMWorkflow",
     ):
@@ -185,6 +187,8 @@ class RBMWorkflow(Workflow):
             target="labels",
             decision=decision or Decision(metric="loss", max_epochs=max_epochs),
             snapshotter=snapshotter,
+            parallel=parallel,
+            prefetch_batches=prefetch_batches,
             name=name,
         )
         self.n_hidden = n_hidden
@@ -231,15 +235,8 @@ class RBMWorkflow(Workflow):
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
         self._eval_step = jax.jit(eval_step)
 
-    def initialize(self, *, seed=None, snapshot=None):
-        if seed is not None:
-            prng.seed_all(seed)
-        if self.state is None and not snapshot:
-            params = rbm_op.init_params(
-                self._n_visible, self.n_hidden, rand_name=self.rand_name
-            )
-            self.state = TrainState.create(params, prng.get("workflow").key())
-        if snapshot:
-            return Workflow.initialize(self, seed=None, snapshot=snapshot)
-        self._host_step = int(self.state.step)
-        self._build_steps()
+    def _create_initial_state(self) -> TrainState:
+        params = rbm_op.init_params(
+            self._n_visible, self.n_hidden, rand_name=self.rand_name
+        )
+        return TrainState.create(params, prng.get("workflow").key())

@@ -132,6 +132,13 @@ class Workflow(Logger):
             self._eval_conf_step = None
 
     # ------------------------------------------------------------------
+    def _create_initial_state(self) -> TrainState:
+        """Template hook: fresh train state for a non-resume initialize.
+        Subclasses with custom param structures override ONLY this."""
+        return TrainState.create(
+            self.model.params, prng.get("workflow").key()
+        )
+
     def initialize(
         self,
         *,
@@ -155,10 +162,8 @@ class Workflow(Logger):
             self.info(
                 "resumed from %s at epoch %d", snapshot, self.decision.epoch
             )
-        else:
-            self.state = TrainState.create(
-                self.model.params, prng.get("workflow").key()
-            )
+        elif self.state is None:
+            self.state = self._create_initial_state()
         if self.parallel is not None:
             self.state = self.parallel.shard_state(self.state)
         # host-side mirror of state.step: lr policies read it every minibatch
