@@ -1,0 +1,49 @@
+"""Topology introspection tests (model summary + DOT export)."""
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader import datasets
+from znicz_tpu.workflow import (
+    StandardWorkflow,
+    model_summary,
+    to_dot,
+)
+
+
+def _wf():
+    prng.seed_all(2)
+    loader = datasets.mnist(n_train=32, n_test=0, minibatch_size=16)
+    return StandardWorkflow(
+        loader,
+        [
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8}},
+            {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+            {"type": "softmax", "->": {"output_sample_shape": 10}},
+        ],
+        decision_config={"max_epochs": 1},
+    )
+
+
+def test_model_summary_counts_params():
+    wf = _wf()
+    s = model_summary(wf.model)
+    assert "all2all_tanh" in s and "dropout" in s and "softmax" in s
+    # 784*8+8 + 0 + 8*10+10 = 6370
+    assert "6,370" in s.replace(" ", ",")
+
+
+def test_to_dot_structure(tmp_path):
+    from znicz_tpu.services import MetricsCSVWriter
+
+    wf = _wf()
+    wf.services = [
+        MetricsCSVWriter(str(tmp_path / "a")),
+        MetricsCSVWriter(str(tmp_path / "b")),
+    ]
+    dot = to_dot(wf)
+    assert dot.startswith("digraph workflow")
+    assert "loader" in dot and "Decision" in dot
+    assert "layer0" in dot and "layer2" in dot
+    # same-class services stay distinct nodes
+    assert "svc_0_MetricsCSVWriter" in dot
+    assert "svc_1_MetricsCSVWriter" in dot
+    assert dot.count("{") == dot.count("}")

@@ -1,7 +1,6 @@
 """Distribution tests on the 8-device CPU mesh (conftest sets
 xla_force_host_platform_device_count=8 — SURVEY.md §4's rebuild strategy)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -9,7 +9,7 @@ from znicz_tpu.core import prng
 from znicz_tpu.core.config import root
 from znicz_tpu.loader import FullBatchLoader
 from znicz_tpu.ops.normalization import layer_norm
-from znicz_tpu.parallel import DataParallel, make_mesh
+from znicz_tpu.parallel import make_mesh
 from znicz_tpu.workflow.transformer import (
     TransformerLMWorkflow,
     init_lm_params,

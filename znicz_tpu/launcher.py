@@ -23,7 +23,6 @@ import argparse
 import importlib.util
 import os
 import sys
-from typing import Optional
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger, setup_logging

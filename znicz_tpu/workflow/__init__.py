@@ -19,3 +19,4 @@ from znicz_tpu.workflow.unsupervised import (  # noqa: F401
 from znicz_tpu.workflow.transformer import (  # noqa: F401
     TransformerLMWorkflow,
 )
+from znicz_tpu.workflow.introspect import model_summary, to_dot  # noqa: F401
