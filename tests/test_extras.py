@@ -46,6 +46,18 @@ class TestEnsemble:
         assert result["n_samples"] == 64
         assert 0.0 <= result["ensemble_err_pct"] <= 100.0
 
+    def test_members_share_one_dataset(self):
+        # members must differ by INIT, not by task: the synthetic dataset
+        # generation stream is pinned across member builds
+        ens = Ensemble(_build, n_models=2, base_seed=70)
+        ens.train()
+        d0 = ens.workflows[0].loader.data["train"]
+        d1 = ens.workflows[1].loader.data["train"]
+        np.testing.assert_array_equal(d0, d1)
+        l0 = ens.workflows[0].loader.labels["train"]
+        l1 = ens.workflows[1].loader.labels["train"]
+        np.testing.assert_array_equal(l0, l1)
+
     def test_soft_and_hard_vote_shapes(self):
         ens = Ensemble(_build, n_models=2, base_seed=60)
         ens.train()

@@ -34,6 +34,23 @@ class TestMoE:
             np.asarray(out), np.asarray(manual), rtol=1e-5, atol=1e-6
         )
 
+    def test_tied_logits_use_exactly_k_experts(self):
+        # a zero row ties every router logit; top_k=1 must still route to
+        # exactly one expert (index order), not the mean of all experts
+        params = self._params()
+        p2 = dict(params)
+        p2["w1"] = jnp.zeros_like(params["w1"])
+        p2["b1"] = jnp.zeros_like(params["b1"])
+        p2["w2"] = jnp.zeros_like(params["w2"])
+        # distinct per-expert constant outputs
+        p2["b2"] = jnp.arange(4, dtype=jnp.float32)[:, None] * jnp.ones(
+            (4, 8)
+        )
+        x = jnp.zeros((3, 8))
+        out = moe.apply(p2, x, top_k=1)
+        chosen = np.unique(np.asarray(out))
+        assert len(chosen) == 1  # one expert's constant, not a mean
+
     def test_topk_gates_sum_to_one(self):
         params = self._params()
         x = jax.random.normal(jax.random.key(1), (5, 8))

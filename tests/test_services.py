@@ -50,6 +50,28 @@ def test_csv_and_plots_written(tmp_path):
     assert (tmp_path / "weights0.png").stat().st_size > 0
 
 
+def test_csv_header_merges_across_runs(tmp_path):
+    # a second run with different splits must rewrite the merged header,
+    # never append rows misaligned with an old header
+    import csv
+
+    prng.seed_all(4)
+    loader1 = datasets.mnist(n_train=64, n_test=0, minibatch_size=32)
+    wf1 = StandardWorkflow(
+        loader1, MLP_LAYERS, decision_config={"max_epochs": 1},
+    )
+    wf1.services = [MetricsCSVWriter(str(tmp_path))]
+    wf1.initialize(seed=4)
+    wf1.run()
+    wf2 = _wf(tmp_path, [MetricsCSVWriter(str(tmp_path))], max_epochs=1)
+    wf2.run()
+    with open(tmp_path / "metrics.csv", newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 2
+    assert rows[0]["test_loss"] == ""  # first run had no test split
+    assert rows[1]["test_loss"] != ""
+
+
 def test_status_writer(tmp_path):
     prng.seed_all(4)
     wf = _wf(tmp_path, [StatusWriter(str(tmp_path))])

@@ -43,10 +43,17 @@ class Ensemble(Logger):
             self.base_seed + 1000 * i for i in range(self.n_models)
         ]
         self.workflows, self.decisions = [], []
+        # Members must differ by init/shuffle, NOT by task: pin the
+        # "datasets" stream to one position for every build (a full
+        # seed_all would hand each member a different synthetic dataset),
+        # and reseed only the model-side streams per member.
+        datasets_state = prng.get("datasets").state_dict()
         for i, seed in enumerate(seeds):
-            prng.seed_all(seed)
+            prng.get("datasets").load_state_dict(datasets_state)
+            for stream in ("default", "workflow", "loader"):
+                prng.get(stream).seed(seed ^ prng.hash_name(stream))
             wf = self.build_fn()
-            wf.initialize(seed=seed)
+            wf.initialize()
             dec = wf.run()
             self.info(
                 "member %d/%d (seed %d): best=%s",

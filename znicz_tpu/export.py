@@ -27,6 +27,13 @@ import numpy as np
 
 MAGIC = b"ZNICZT01"
 
+# layer types native/znicz_infer.cc implements; export refuses anything else
+# so deployment failures surface BEFORE training, not at inference time
+NATIVE_SUPPORTED_PREFIXES = (
+    "all2all", "softmax", "conv", "max_pooling", "avg_pooling",
+    "maxabs_pooling", "stochastic_pooling", "norm", "dropout", "activation_",
+)
+
 # forward-config keys the native engine understands, per layer type
 _CONFIG_KEYS = (
     "kx", "ky", "sliding", "padding", "n_kernels", "n_channels",
@@ -35,18 +42,41 @@ _CONFIG_KEYS = (
 )
 
 
-def export_model(model, path: str) -> Dict[str, Any]:
-    """Write ``model`` (workflow.model.Model) to ``path``; returns header."""
-    layers = []
-    blobs = []
-    offset = 0
-    for spec, params in zip(model.layer_specs, model.params):
+def validate_exportable(model) -> None:
+    """Raise ValueError when the model cannot run on the native engine —
+    call this BEFORE training (the launcher's --export precheck does)."""
+    if not hasattr(model, "layer_specs"):
+        raise ValueError(
+            "model has no layer_specs (not a layer-list Model); cannot "
+            "export for the native engine"
+        )
+    unsupported = [
+        spec["type"]
+        for spec in model.layer_specs
+        if not spec["type"].startswith(NATIVE_SUPPORTED_PREFIXES)
+    ]
+    if unsupported:
+        raise ValueError(
+            f"layer type(s) {sorted(set(unsupported))} are not implemented "
+            "by the native inference engine (native/znicz_infer.cc); the "
+            "exported artifact would fail at deployment"
+        )
+    for spec in model.layer_specs:
         if isinstance(spec.get("padding"), str):
             raise ValueError(
                 f"layer {spec['type']!r} uses padding={spec['padding']!r}; "
                 "native export needs explicit (left, top, right, bottom) "
                 "padding — string padding depends on input size"
             )
+
+
+def export_model(model, path: str) -> Dict[str, Any]:
+    """Write ``model`` (workflow.model.Model) to ``path``; returns header."""
+    validate_exportable(model)
+    layers = []
+    blobs = []
+    offset = 0
+    for spec, params in zip(model.layer_specs, model.params):
         config = {
             key: _jsonable(spec[key]) for key in _CONFIG_KEYS if key in spec
         }

@@ -172,11 +172,18 @@ class GeneticOptimizer(Logger):
             node[key] = v
 
 
-def optimize_workflow(module, launcher, *, generations: int, **ga_kwargs):
+def optimize_workflow(
+    module, launcher, *, generations: int, tunables=None, **ga_kwargs
+):
     """Drive ``--optimize``: evolve the Tune leaves of the config tree by
-    repeatedly building + training the module's workflow."""
-    tunables = find_tunables(root)
-    opt_holder = {}
+    repeatedly building + training the module's workflow.
+
+    ``tunables``: pass a pre-collected ``find_tunables(root)`` result when
+    the caller ran anything (e.g. an export probe) that may have
+    materialized extra Tune copies into the tree since startup.
+    """
+    if tunables is None:
+        tunables = find_tunables(root)
 
     def evaluate(genome) -> float:
         for v, (node, key, _) in zip(genome, tunables):
@@ -196,7 +203,6 @@ def optimize_workflow(module, launcher, *, generations: int, **ga_kwargs):
         return float(dec.best_value)
 
     optimizer = GeneticOptimizer(evaluate, tunables, **ga_kwargs)
-    opt_holder["optimizer"] = optimizer
     result = optimizer.run(generations)
     optimizer.apply_genome(result["best_genome"])  # leave best config applied
     optimizer.info(

@@ -108,12 +108,20 @@ class Launcher(Logger):
         """Initialize and run the loaded workflow."""
         if self.workflow is None:
             raise RuntimeError("run(load, main): call load(...) before main()")
-        if self.args.export and not hasattr(self.workflow.model, "_replace"):
-            # fail BEFORE training, not after hours of it
-            raise SystemExit(
-                "--export supports layer-list models (StandardWorkflow); "
-                f"{type(self.workflow).__name__} has no exportable model"
-            )
+        if self.args.export:
+            # fail BEFORE training, not after hours of it: class AND layer
+            # types must be native-engine compatible
+            from znicz_tpu.export import validate_exportable
+
+            if not hasattr(self.workflow.model, "_replace"):
+                raise SystemExit(
+                    "--export supports layer-list models (StandardWorkflow); "
+                    f"{type(self.workflow).__name__} has no exportable model"
+                )
+            try:
+                validate_exportable(self.workflow.model)
+            except ValueError as e:
+                raise SystemExit(f"--export: {e}") from None
         self.workflow.initialize(
             seed=self.args.random_seed, snapshot=self.args.snapshot, **kwargs
         )
@@ -160,8 +168,12 @@ def run_args(argv=None) -> Launcher:
             "(reference workflow convention)"
         )
     if args.optimize:
-        from znicz_tpu.genetics import optimize_workflow
+        from znicz_tpu.genetics import find_tunables, optimize_workflow
 
+        # collect the search space BEFORE any probe: workflow modules may
+        # materialize Tune copies into root during run(), and those must not
+        # widen the genome
+        tunables = find_tunables(root)
         # export must capture the BEST genome's weights, not whichever
         # candidate trained last: defer it past the search, then retrain
         # once with the winning config applied
@@ -180,7 +192,7 @@ def run_args(argv=None) -> Launcher:
             _prng.reset()
             _prng.load_state_dict(prng_state)
         launcher.result = optimize_workflow(
-            module, launcher, generations=args.optimize
+            module, launcher, generations=args.optimize, tunables=tunables
         )
         if export_path:
             args.export = export_path

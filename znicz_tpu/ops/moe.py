@@ -77,10 +77,12 @@ def apply(
     if top_k >= e:
         gates = jax.nn.softmax(logits, axis=-1)
     else:
-        top_vals, _ = jax.lax.top_k(logits, top_k)
-        threshold = top_vals[..., -1:]
-        masked = jnp.where(logits >= threshold, logits, -jnp.inf)
-        gates = jax.nn.softmax(masked, axis=-1)  # [B, E], zeros off-top-k
+        # exact top-k membership via indices (a >=threshold mask would
+        # activate EVERY tied expert — e.g. all of them for a zero row)
+        top_vals, top_idx = jax.lax.top_k(logits, top_k)
+        g = jax.nn.softmax(top_vals, axis=-1)  # [B, k]
+        onehot = jax.nn.one_hot(top_idx, e, dtype=g.dtype)  # [B, k, E]
+        gates = jnp.einsum("bk,bke->be", g, onehot)
     # dense dispatch: every expert runs every token; gate combines.
     h = jnp.einsum(
         "bf,efh->ebh", x, params["w1"], preferred_element_type=jnp.float32

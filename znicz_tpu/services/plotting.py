@@ -21,13 +21,17 @@ import numpy as np
 
 
 class MetricsCSVWriter:
-    """Append per-epoch metrics to metrics.csv (machine-readable history)."""
+    """Append per-epoch metrics to metrics.csv (machine-readable history).
+
+    Appending a run whose columns differ from an existing file's header
+    rewrites the file with the merged header (absent values stay empty) —
+    rows and header can never silently misalign.
+    """
 
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, "metrics.csv")
-        self._wrote_header = os.path.exists(self._path)
 
     def on_epoch(self, workflow, verdict) -> None:
         summary = verdict["summary"]
@@ -36,12 +40,21 @@ class MetricsCSVWriter:
             for key in ("loss", "n_err", "err_pct", "n_samples"):
                 if key in m:
                     row[f"{split}_{key}"] = m[key]
-        write_header = not self._wrote_header
-        with open(self._path, "a", newline="") as f:
-            w = csv.DictWriter(f, fieldnames=list(row))
-            if write_header:
-                w.writeheader()
-                self._wrote_header = True
+        existing_rows: list = []
+        fieldnames = list(row)
+        if os.path.exists(self._path):
+            with open(self._path, newline="") as f:
+                reader = csv.DictReader(f)
+                existing_rows = list(reader)
+                old_fields = reader.fieldnames or []
+            fieldnames = list(old_fields) + [
+                k for k in row if k not in old_fields
+            ]
+        with open(self._path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=fieldnames, restval="")
+            w.writeheader()
+            for r in existing_rows:
+                w.writerow(r)
             w.writerow(row)
 
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator
 
 
 @contextlib.contextmanager
@@ -39,8 +39,6 @@ class StepTimer:
     def __init__(self):
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
-        self._start: Optional[float] = None
-        self._phase: Optional[str] = None
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:

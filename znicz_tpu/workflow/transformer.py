@@ -157,7 +157,7 @@ class TransformerLMWorkflow(Workflow):
         self.max_seq = int(loader.sample_shape[0])
 
     def _batch_target(self, mb):
-        return jnp.zeros((len(mb.mask),), jnp.int32)  # unused
+        return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
 
     def _attention_fn(self):
         if not self.sequence_parallel:

@@ -14,6 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from znicz_tpu.core import prng
 from znicz_tpu.loader.base import TRAIN, Loader
@@ -76,7 +77,7 @@ class KohonenWorkflow(Workflow):
         self._n_input = int(jnp.prod(jnp.asarray(loader.sample_shape)))
 
     def _batch_target(self, mb):
-        return jnp.zeros((len(mb.mask),), jnp.int32)  # unused
+        return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
 
     def _build_steps(self):
         coords = kh.grid_coords(self.sx, self.sy)
@@ -153,8 +154,6 @@ class KohonenWorkflow(Workflow):
 
     def weights_map(self):
         """[sy, sx, features] view of the trained map (for plotting)."""
-        import numpy as np
-
         w = np.asarray(self.state.params["weights"])
         return w.reshape(self.sy, self.sx, -1)
 
@@ -198,7 +197,7 @@ class RBMWorkflow(Workflow):
         self._n_visible = int(jnp.prod(jnp.asarray(loader.sample_shape)))
 
     def _batch_target(self, mb):
-        return jnp.zeros((len(mb.mask),), jnp.int32)  # unused
+        return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
 
     def _build_steps(self):
         def train_step(state: TrainState, x, y, mask, lr_scale):

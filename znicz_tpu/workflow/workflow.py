@@ -172,14 +172,17 @@ class Workflow(Logger):
         self._build_steps()
 
     def _batch_target(self, mb):
+        """HOST-side target array: the caller's ``put`` does the (sharded)
+        device placement — returning a device array here would force a
+        blocking readback inside DataParallel.shard_batch every minibatch."""
         if self.target == "labels":
-            return jnp.asarray(mb.labels)
+            return mb.labels
         if self.target == "targets":
-            return jnp.asarray(mb.targets)
+            return mb.targets
         if self.target == "input":
             # autoencoder: reconstruct the input; evaluator.mse flattens, so
             # the model output only needs to match total feature count
-            return jnp.asarray(mb.data)
+            return mb.data
         raise ValueError(f"unknown target {self.target!r}")
 
     def host_state(self) -> Dict[str, Any]:
