@@ -385,8 +385,28 @@ struct Model {
       layer.config = lj.at("config");
       for (const auto& [name, pj] : lj.at("params").object) {
         int64_t offset = static_cast<int64_t>(pj.at("offset").number);
+        std::vector<int> shape = pj.at("shape").as_int_array();
+        // Never trust header-declared offsets/shapes: a truncated or
+        // inconsistent file must fail cleanly, not read out of bounds.
+        // Guard the product against int64 overflow by bailing as soon as
+        // it exceeds the number of floats the blob could possibly hold.
+        const int64_t blob_floats =
+            static_cast<int64_t>(m.blob.size() / sizeof(float));
+        int64_t numel = 1;
+        bool shape_ok = true;
+        for (int d : shape) {
+          if (d <= 0 || numel > blob_floats) { shape_ok = false; break; }
+          numel *= d;
+        }
+        if (!shape_ok || offset < 0 || offset % 4 != 0 ||
+            offset / static_cast<int64_t>(sizeof(float)) >
+                blob_floats - numel) {
+          throw std::runtime_error(
+              "param '" + name + "' of layer '" + layer.type +
+              "' exceeds weight blob (truncated or corrupt file): " + path);
+        }
         layer.params[name] = {
-            pj.at("shape").as_int_array(),
+            std::move(shape),
             reinterpret_cast<const float*>(m.blob.data() + offset)};
       }
       m.layers.push_back(std::move(layer));
@@ -400,9 +420,16 @@ struct Model {
       const Json& cfg = layer.config;
       if (t.rfind("all2all", 0) == 0 || t == "softmax") {
         const auto& wp = layer.params.at("weights");
+        if (wp.first.size() != 2)
+          throw std::runtime_error(
+              "layer '" + t + "': weights must be rank 2");
         int n_in = wp.first[0], n_out = wp.first[1];
         // flatten trailing dims
         x.shape = {x.dim(0), static_cast<int>(x.size() / x.dim(0))};
+        if (x.dim(1) != n_in)
+          throw std::runtime_error(
+              "layer '" + t + "': input has " + std::to_string(x.dim(1)) +
+              " features per sample, weights expect " + std::to_string(n_in));
         bool include_bias = !cfg.has("include_bias") ||
                             cfg.at("include_bias").boolean;
         const float* b = layer.params.count("bias")
@@ -413,7 +440,13 @@ struct Model {
         if (t == "softmax") softmax_rows(&x);
       } else if (t.rfind("conv", 0) == 0) {
         const auto& wp = layer.params.at("weights");
+        if (wp.first.size() != 4)
+          throw std::runtime_error(
+              "layer '" + t + "': weights must be rank 4 (HWIO)");
         int ky = wp.first[0], kx = wp.first[1], k = wp.first[3];
+        if (x.shape.size() != 4 || x.dim(3) != wp.first[2])
+          throw std::runtime_error(
+              "layer '" + t + "': input channels do not match weights");
         int sx, sy;
         read_sliding(cfg, &sx, &sy, 1, 1);
         const float* b = layer.params.count("bias")

@@ -238,6 +238,20 @@ class TestSnapshotResume:
         files = sorted(p.name for p in tmp_path.iterdir())
         assert files == ["t_epoch3.pickle", "t_epoch4.pickle"]
 
+    def test_snapshot_keep_limit_survives_restart(self, tmp_path):
+        from znicz_tpu.nn.train_state import TrainState
+
+        st = TrainState.create([{"w": jnp.ones(2)}], jax.random.key(0))
+        snap = Snapshotter(str(tmp_path), "t", interval=1, keep=2, compress=False)
+        for e in range(3):
+            snap.maybe_save(st, {}, epoch=e, improved=False)
+        # new process: retention must count snapshots the old process wrote
+        snap2 = Snapshotter(str(tmp_path), "t", interval=1, keep=2, compress=False)
+        for e in range(3, 5):
+            snap2.maybe_save(st, {}, epoch=e, improved=False)
+        files = sorted(p.name for p in tmp_path.iterdir())
+        assert files == ["t_epoch3.pickle", "t_epoch4.pickle"]
+
     def test_state_roundtrip_preserves_key(self, tmp_path):
         from znicz_tpu.nn.train_state import TrainState
 

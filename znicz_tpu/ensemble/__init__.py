@@ -49,9 +49,13 @@ class Ensemble(Logger):
         # and reseed only the model-side streams per member.
         datasets_state = prng.get("datasets").state_dict()
         for i, seed in enumerate(seeds):
+            # Reseed EVERY stream — including custom rand_name streams that
+            # build_fn will only register DURING the build: seed_all sets the
+            # global seed, so late-created generators derive member-specific
+            # defaults too.  "datasets" is then re-pinned so all members
+            # share one task (they must differ by init, not by data).
+            prng.seed_all(seed)
             prng.get("datasets").load_state_dict(datasets_state)
-            for stream in ("default", "workflow", "loader"):
-                prng.get(stream).seed(seed ^ prng.hash_name(stream))
             wf = self.build_fn()
             wf.initialize()
             dec = wf.run()

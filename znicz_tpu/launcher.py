@@ -88,14 +88,37 @@ class Launcher(Logger):
             dc["max_epochs"] = self.args.stop_after
             wf_kwargs["decision_config"] = dc
         if self.args.data_parallel and "parallel" not in wf_kwargs:
+            import inspect
+
             from znicz_tpu.parallel import DataParallel
 
             dp = DataParallel()
+            # Signature check (not try/except TypeError): an unrelated
+            # TypeError raised inside the constructor must propagate, not
+            # silently retry without DP.
             try:
+                sig = inspect.signature(workflow_cls)
+                accepts = "parallel" in sig.parameters or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values()
+                )
+                # A module may already pass `parallel` positionally; its
+                # explicit choice wins over the CLI default — injecting the
+                # kwarg would raise "multiple values for 'parallel'".
+                try:
+                    bound = sig.bind(*wf_args, **wf_kwargs)
+                    if "parallel" in bound.arguments:
+                        self.workflow = workflow_cls(*wf_args, **wf_kwargs)
+                        return self.workflow
+                except TypeError:
+                    pass  # bind failure: let the real constructor report it
+            except (TypeError, ValueError):  # C callables, odd metaclasses
+                accepts = True
+            if accepts:
                 self.workflow = workflow_cls(
                     *wf_args, **{**wf_kwargs, "parallel": dp}
                 )
-            except TypeError:
+            else:
                 # user workflows predating the kwarg: attribute assignment
                 # before initialize() has identical semantics
                 self.workflow = workflow_cls(*wf_args, **wf_kwargs)

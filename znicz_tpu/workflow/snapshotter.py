@@ -14,6 +14,7 @@ from __future__ import annotations
 import gzip
 import os
 import pickle
+import re
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -93,8 +94,17 @@ class Snapshotter:
         self.compress = compress
         self.interval = interval
         self.keep = keep
-        self._kept: list = []
         os.makedirs(directory, exist_ok=True)
+        # Recover periodic snapshots from a previous process so "keep at
+        # most N" holds across restarts, oldest (lowest epoch tag) first.
+        existing = []
+        for fname in os.listdir(directory):
+            m = re.fullmatch(
+                re.escape(prefix) + r"_epoch(\d+)\.pickle(\.gz)?", fname
+            )
+            if m:
+                existing.append((int(m.group(1)), os.path.join(directory, fname)))
+        self._kept: list = [p for _, p in sorted(existing)]
 
     # -- paths ---------------------------------------------------------------
     def _path(self, tag: str) -> str:
