@@ -74,23 +74,25 @@ def main() -> None:
     y = jnp.asarray(mb.labels)
     mask = jnp.asarray(mb.mask)
 
-    # compile + warmup
-    state, _ = wf._train_step(wf.state, x, y, mask, 1.0)
-    state, metrics = wf._train_step(state, x, y, mask, 1.0)
-    jax.block_until_ready(metrics["loss"])
+    # compile + warmup (steps carry the on-device metric accumulator)
+    state, acc = wf._train_step(
+        wf.state, x, y, mask, 1.0, wf._acc_init(), wf._ctx
+    )
+    state, acc = wf._train_step(state, x, y, mask, 1.0, acc, wf._ctx)
+    jax.block_until_ready(acc)
     print(f"setup+compile {time.time()-t_setup:.1f}s", file=sys.stderr)
 
     # Remote-relay transports add a large fixed sync overhead per fetch;
     # difference two run lengths so the fixed cost cancels and only true
     # per-step device time remains.
     def timed(n):
-        nonlocal state
+        nonlocal state, acc
         t0 = time.time()
         for _ in range(n):
-            state, m = wf._train_step(state, x, y, mask, 1.0)
+            state, acc = wf._train_step(state, x, y, mask, 1.0, acc, wf._ctx)
         # A value fetch (not just block_until_ready) is the only reliable
         # full-pipeline sync under remote-relay transports.
-        float(m["loss"])
+        float(jax.device_get(acc)[0])
         return time.time() - t0
 
     timed(2)  # absorb the donated-buffer-layout recompile
@@ -107,6 +109,88 @@ def main() -> None:
 
     images_per_sec = batch / dt
 
+    # ---- end-to-end epoch throughput: the production run_epoch path with
+    # the loader IN the loop (shuffle, index gather, prefetch thread,
+    # on-device normalize, per-epoch metric sync).  Two modes:
+    #   device_resident — dataset pool in HBM, per batch only the index
+    #     vector crosses host->device (the TPU-first mode for datasets that
+    #     fit on-chip); this is the headline epoch number.
+    #   streaming — u8 minibatches cross host->device each step (the
+    #     ImageNet-at-scale mode).  Through this harness's remote relay the
+    #     link runs at tens of MB/s (measured + reported below) vs multi-
+    #     GB/s host DMA on co-located hardware, so the number is reported
+    #     alongside the measured link bandwidth rather than as a framework
+    #     property.
+    import numpy as np
+
+    from znicz_tpu.loader.fullbatch import FullBatchLoader
+    from znicz_tpu.workflow import StandardWorkflow
+
+    n_epoch_imgs = int(os.environ.get("BENCH_EPOCH_IMAGES", str(8 * batch)))
+    gen = np.random.default_rng(0)
+    images_u8 = gen.integers(
+        0, 256, (n_epoch_imgs, 227, 227, 3)
+    ).astype(np.uint8)
+    labels = gen.integers(0, 1000, n_epoch_imgs).astype(np.int32)
+
+    def epoch_rate(device_resident: bool, n_epochs: int) -> float:
+        e_loader = FullBatchLoader(
+            {"train": images_u8},
+            {"train": labels},
+            minibatch_size=batch,
+            normalization="range",
+            normalization_kwargs={"scale": 255.0, "shift": -0.5},
+            device_convert=not device_resident,
+            device_resident=device_resident,
+        )
+        ewf = StandardWorkflow(
+            e_loader,
+            root.alexnet.get("layers"),
+            decision_config={"max_epochs": 10000},
+            compute_dtype="bfloat16",
+            name="AlexNetEpochBench",
+        )
+        ewf.initialize(seed=7)
+        ewf.run_epoch()  # compile + warmup
+        t0 = time.time()
+        for _ in range(n_epochs):
+            ewf.run_epoch()
+        return n_epoch_imgs * n_epochs / (time.time() - t0)
+
+    epoch_images_per_sec = epoch_rate(True, 3)
+    print(
+        f"epoch bench (device-resident): {epoch_images_per_sec:.0f} img/s",
+        file=sys.stderr,
+    )
+    streaming_images_per_sec = epoch_rate(False, 1)
+
+    # measured host->device link bandwidth: difference two chunk sizes so
+    # the fixed per-round-trip sync cost cancels (same methodology as the
+    # step timing above)
+    def put_time(rows):
+        chunk = images_u8[:rows]
+        dev = jax.device_put(chunk)
+        float(jnp.sum(dev.astype(jnp.float32))[None][0])  # force arrival
+        t0 = time.time()
+        dev = jax.device_put(chunk)
+        float(jnp.sum(dev.astype(jnp.float32))[None][0])
+        return chunk.nbytes, time.time() - t0
+
+    put_time(64)  # warm both program shapes
+    b_small, t_small = put_time(64)
+    b_large, t_large = put_time(512)
+    dt = t_large - t_small
+    put_mbps = (
+        (b_large - b_small) / dt / 1e6
+        if dt > 0
+        else b_large / max(t_large, 1e-9) / 1e6
+    )
+    print(
+        f"epoch bench (streaming): {streaming_images_per_sec:.0f} img/s; "
+        f"host->device link ~{put_mbps:.0f} MB/s",
+        file=sys.stderr,
+    )
+
     # secondary metric (BASELINE.json): MNIST MLP step latency
     from znicz_tpu.models import mnist as mnist_model
 
@@ -120,38 +204,29 @@ def main() -> None:
     mx, my, mmask = (
         jnp.asarray(mmb.data), jnp.asarray(mmb.labels), jnp.asarray(mmb.mask)
     )
-    mstate = mwf.state
 
-    def mnist_timed(n):
-        nonlocal mstate
-        t0 = time.time()
-        for _ in range(n):
-            mstate, mm = mwf._train_step(mstate, mx, my, mmask, 1.0)
-        float(mm["loss"])
-        return time.time() - t0
+    # Device-side measurement: N steps inside ONE compiled lax.fori_loop, so
+    # per-step host dispatch and relay sync overhead amortize to zero and the
+    # quotient is pure device step time (sub-ms steps would otherwise drown
+    # in transport noise).
+    from jax import lax
 
-    # sub-ms steps drown in relay sync noise; a noisy SHORT run shrinks the
-    # difference, so min() would bias low — use the median of three pairs
-    mnist_timed(3)
-    mnist_timed(3)
-    estimates = []
-    for _ in range(3):
-        m_short, m_long = mnist_timed(300), mnist_timed(900)
-        if m_long > m_short:
-            estimates.append((m_long - m_short) / 600 * 1000)
-    if len(estimates) == 3:
-        mnist_step_ms = sorted(estimates)[1]
-    elif len(estimates) == 2:  # sorted[1] of two would pick the larger
-        mnist_step_ms = sum(estimates) / 2
-    elif estimates:
-        mnist_step_ms = estimates[0]
-    else:
-        mnist_step_ms = mnist_timed(900) / 900 * 1000
-    if len(estimates) < 3:
-        print(
-            f"mnist timing: {3 - len(estimates)} noisy pair(s) dropped",
-            file=sys.stderr,
-        )
+    step_fn = mwf.train_step_fn
+    N_INNER = 1000
+
+    @jax.jit
+    def mnist_many_steps(state):
+        def body(_, s):
+            s2, _m = step_fn(s, mx, my, mmask, 1.0, mwf._ctx)
+            return s2
+        return lax.fori_loop(0, N_INNER, body, state)
+
+    mstate = mnist_many_steps(mwf.state)  # compile + warmup
+    jax.block_until_ready(mstate.params[0]["weights"])
+    t0 = time.time()
+    mstate = mnist_many_steps(mstate)
+    jax.block_until_ready(mstate.params[0]["weights"])
+    mnist_step_ms = (time.time() - t0) / N_INNER * 1000
     fwd_flops = _model_flops_per_image(
         root.alexnet.get("layers"), wf.loader.sample_shape
     )
@@ -169,7 +244,16 @@ def main() -> None:
                 "mfu": round(mfu, 4),
                 "batch": batch,
                 "step_ms": round(1000 * dt, 2),
+                "epoch_images_per_sec": round(epoch_images_per_sec, 2),
+                "epoch_vs_compute_only": round(
+                    epoch_images_per_sec / images_per_sec, 4
+                ),
+                "epoch_streaming_images_per_sec": round(
+                    streaming_images_per_sec, 2
+                ),
+                "host_to_device_MBps": round(put_mbps, 1),
                 "mnist_mlp_step_ms": round(mnist_step_ms, 3),
+                "mnist_step_method": "fori_loop_1000",
                 "device": str(jax.devices()[0].device_kind),
             }
         )

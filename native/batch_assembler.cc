@@ -63,6 +63,51 @@ void gather_rows_u8_normalize(const uint8_t* data, int64_t feat,
   });
 }
 
+// Gather random/center crops (optionally h-flipped) from packed u8 images.
+// data: [n_imgs, H, W, C] u8; per sample i: copy the window
+// data[indices[i], oy[i]:oy[i]+out_h, ox[i]:ox[i]+out_w, :] into
+// out[i, :, :, :], reversing the W axis when flip[i] != 0.  Output stays u8 —
+// the affine normalize runs on-device (fused into the XLA step), so the
+// host->device transfer is 4x smaller than f32.
+void crop_gather_u8(const uint8_t* data, int64_t h, int64_t w, int64_t c,
+                    const int64_t* indices, const int64_t* oy,
+                    const int64_t* ox, const uint8_t* flip, int64_t batch,
+                    int64_t out_h, int64_t out_w, uint8_t* out) {
+  const int64_t img = h * w * c;
+  const int64_t out_img = out_h * out_w * c;
+  parallel_for(batch, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      const uint8_t* src = data + indices[i] * img + (oy[i] * w + ox[i]) * c;
+      uint8_t* dst = out + i * out_img;
+      if (!flip[i]) {
+        for (int64_t r = 0; r < out_h; ++r)
+          std::memcpy(dst + r * out_w * c, src + r * w * c,
+                      static_cast<size_t>(out_w) * c);
+      } else {
+        for (int64_t r = 0; r < out_h; ++r) {
+          const uint8_t* srow = src + r * w * c;
+          uint8_t* drow = dst + r * out_w * c;
+          for (int64_t col = 0; col < out_w; ++col)
+            std::memcpy(drow + col * c, srow + (out_w - 1 - col) * c,
+                        static_cast<size_t>(c));
+        }
+      }
+    }
+  });
+}
+
+// Plain u8 row gather (no conversion): feeds the u8->device path where the
+// normalize happens on-device instead of on-host.
+void gather_rows_u8_raw(const uint8_t* data, int64_t feat,
+                        const int64_t* indices, int64_t batch, uint8_t* out) {
+  parallel_for(batch, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      std::memcpy(out + i * feat, data + indices[i] * feat,
+                  static_cast<size_t>(feat));
+    }
+  });
+}
+
 // In-place affine normalize of an f32 block (mean/disp style per-feature).
 // out[i, j] = (out[i, j] - mean[j]) * inv_disp[j]
 void normalize_rows_f32(float* data, int64_t rows, int64_t feat,

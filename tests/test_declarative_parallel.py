@@ -1,0 +1,226 @@
+"""Declarative parallelism UX: mesh specs, moe/attention layer entries,
+tensor-parallel transformer — the config-driven surface over the DP/TP/SP/EP
+primitives (VERDICT round-1 item 2; reference UX parity target is
+``znicz/standard_workflow.py``-level declarativeness [SURVEY.md 2.3])."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.core.config import root
+from znicz_tpu.loader import FullBatchLoader, datasets
+from znicz_tpu.parallel import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    DataParallel,
+    make_mesh,
+    mesh_from_spec,
+    parse_mesh_spec,
+)
+from znicz_tpu.workflow import StandardWorkflow
+from znicz_tpu.workflow.transformer import TransformerLMWorkflow, lm_tp_rules
+
+
+class TestMeshSpec:
+    def test_parse(self):
+        assert parse_mesh_spec("data=4,model=2") == {"data": 4, "model": 2}
+        assert parse_mesh_spec("data=2, model=2, pipe=2") == {
+            "data": 2, "model": 2, "pipe": 2,
+        }
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError):
+            parse_mesh_spec("data=4,bogus=2")
+        with pytest.raises(ValueError):
+            parse_mesh_spec("data")
+        with pytest.raises(ValueError):
+            parse_mesh_spec("data=0")
+
+    def test_mesh_from_spec(self):
+        m = mesh_from_spec("data=4,model=2")
+        assert m.shape[DATA_AXIS] == 4 and m.shape[MODEL_AXIS] == 2
+        # unlisted data axis soaks up remaining devices
+        m2 = mesh_from_spec("model=2")
+        assert m2.shape[DATA_AXIS] == 4
+        m3 = mesh_from_spec("data=2,model=2,pipe=2")
+        assert m3.shape[PIPE_AXIS] == 2
+
+    def test_cli_mesh_flag_builds_tp_dataparallel(self, tmp_path):
+        from znicz_tpu.launcher import run_args
+
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        saved = root.wine.to_dict()
+        try:
+            root.wine.decision.update({"max_epochs": 1})
+            # wine: 178 samples, minibatch 10 not divisible by 2 -> fix size
+            root.wine.loader.update({"minibatch_size": 16})
+            launcher = run_args(
+                [str(wf_py), "--mesh", "data=2,model=2", "--random-seed", "3"]
+            )
+        finally:
+            root.wine.clear()
+            root.wine.update(saved)
+        dp = launcher.workflow.parallel
+        assert isinstance(dp, DataParallel)
+        assert dp.mesh.shape[DATA_AXIS] == 2
+        assert dp.mesh.shape[MODEL_AXIS] == 2
+        assert dp.tp
+
+
+class TestMoELayerEntry:
+    def test_moe_in_layer_list_trains(self):
+        prng.seed_all(21)
+        loader = datasets.mnist(n_train=256, n_test=64, minibatch_size=64)
+        wf = StandardWorkflow(
+            loader,
+            [
+                {"type": "all2all_tanh", "->": {"output_sample_shape": 32}},
+                {"type": "moe",
+                 "->": {"n_experts": 4, "n_hidden": 32, "top_k": 2}},
+                {"type": "softmax", "->": {"output_sample_shape": 10}},
+            ],
+            decision_config={"max_epochs": 3},
+            default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+        )
+        wf.initialize(seed=21)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
+        assert dec.history[-1]["test"]["err_pct"] < 30.0
+        assert "moe" in wf.model.layer_types
+
+    def test_moe_flattens_conv_activations(self):
+        prng.seed_all(22)
+        from znicz_tpu.workflow import build
+
+        model = build(
+            [
+                {"type": "conv_relu",
+                 "->": {"n_kernels": 4, "kx": 3, "ky": 3}},
+                {"type": "moe", "->": {"n_experts": 2, "n_hidden": 8}},
+                {"type": "softmax", "->": {"output_sample_shape": 3}},
+            ],
+            (8, 8, 1),
+        )
+        import jax.numpy as jnp
+
+        y = model.apply(model.params, jnp.zeros((2, 8, 8, 1)))
+        assert y.shape == (2, 3)
+
+
+class TestAttentionLayerEntry:
+    def test_attention_block_trains_sequence_classifier(self):
+        """[T, D] per-sample input through attention blocks + softmax head:
+        class = which half of the sequence carries the bright token."""
+        prng.seed_all(23)
+        gen = np.random.default_rng(0)
+        n, t, d = 256, 8, 16
+        labels = gen.integers(0, 2, n).astype(np.int32)
+        x = gen.normal(0, 0.1, (n, t, d)).astype(np.float32)
+        for i in range(n):
+            pos = labels[i] * (t // 2) + gen.integers(0, t // 2)
+            x[i, pos, :] += 2.0
+        loader = FullBatchLoader(
+            {"train": x[:192], "test": x[192:]},
+            {"train": labels[:192], "test": labels[192:]},
+            minibatch_size=64,
+        )
+        wf = StandardWorkflow(
+            loader,
+            [
+                {"type": "attention", "->": {"n_heads": 2, "causal": False}},
+                {"type": "attention", "->": {"n_heads": 2, "causal": False}},
+                {"type": "softmax", "->": {"output_sample_shape": 2}},
+            ],
+            decision_config={"max_epochs": 8},
+            default_hyper={"learning_rate": 0.05, "gradient_moment": 0.9},
+        )
+        wf.initialize(seed=23)
+        dec = wf.run()
+        assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
+        assert dec.history[-1]["test"]["err_pct"] < 25.0
+
+    def test_attention_needs_sequence_input(self):
+        from znicz_tpu.workflow import build
+
+        with pytest.raises(ValueError, match="attention"):
+            build([{"type": "attention", "->": {"n_heads": 2}}], (16,))
+
+
+def _lm_history(tokens, *, parallel=None, tp=False, sp=False, mesh=None,
+                epochs=2):
+    prng.seed_all(31)
+    ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=16)
+    wf = TransformerLMWorkflow(
+        ld, vocab=16, d_model=32, n_layers=2, n_heads=4,
+        max_epochs=epochs,
+        sequence_parallel=sp,
+        tensor_parallel=tp,
+        mesh=mesh,
+        parallel=parallel,
+    )
+    wf.initialize(seed=31)
+    return wf, wf.run().history
+
+
+class TestTransformerTP:
+    @pytest.fixture(scope="class")
+    def tokens(self):
+        return np.asarray(
+            np.random.default_rng(4).integers(0, 16, (32, 32)), np.int32
+        )
+
+    def test_tp_rules_cover_all_params(self):
+        from jax.sharding import PartitionSpec as P
+
+        assert lm_tp_rules("[1]['wq']", None) == P(None, MODEL_AXIS)
+        assert lm_tp_rules("[1]['wo']", None) == P(MODEL_AXIS, None)
+        assert lm_tp_rules("[1]['w_up']", None) == P(None, MODEL_AXIS)
+        assert lm_tp_rules("[1]['w_down']", None) == P(MODEL_AXIS, None)
+        assert lm_tp_rules("[1]['up_bias']", None) == P(MODEL_AXIS)
+        assert lm_tp_rules("[2]['head']", None) == P(None, MODEL_AXIS)
+        assert lm_tp_rules("[0]['embed']", None) == P()
+        assert lm_tp_rules("[1]['ln1_scale']", None) == P()
+
+    def test_tp_matches_single_device(self, tokens):
+        _, base = _lm_history(tokens)
+        mesh = make_mesh(2, 4)
+        wf, tp_hist = _lm_history(
+            tokens, parallel=DataParallel(mesh), tp=True
+        )
+        # params actually sharded over the model axis
+        qkv = wf.state.params[1]["wq"]
+        assert not qkv.is_fully_replicated
+        for ea, eb in zip(base, tp_hist):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"], rtol=2e-3
+            )
+
+    def test_tp_composes_with_sp(self, tokens):
+        _, base = _lm_history(tokens)
+        mesh = make_mesh(4, 2)
+        _, both = _lm_history(
+            tokens, parallel=DataParallel(mesh), tp=True, sp=True, mesh=mesh
+        )
+        for ea, eb in zip(base, both):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"], rtol=2e-3
+            )
+
+    def test_tp_requires_model_axis(self, tokens):
+        with pytest.raises(ValueError, match="model axis"):
+            _lm_history(
+                tokens, parallel=DataParallel(make_mesh(8, 1)), tp=True
+            )
+
+    def test_tp_requires_divisible_heads(self, tokens):
+        ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=16)
+        with pytest.raises(ValueError, match="divisible"):
+            TransformerLMWorkflow(
+                ld, vocab=16, d_model=30, n_layers=1, n_heads=3,
+                tensor_parallel=True,
+                parallel=DataParallel(make_mesh(4, 2)),
+            )

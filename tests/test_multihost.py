@@ -1,0 +1,96 @@
+"""Multi-host bring-up proof: 2 real processes, localhost coordinator, CPU.
+
+Exercises the reference's master/slave replacement end to end [SURVEY.md 3.4
+``--listen``/``--master-address`` -> ``--coordinator``/``--num-processes``/
+``--process-id``]: both processes rendezvous via ``jax.distributed``, build
+ONE global mesh spanning both, and run a jitted cross-process reduction.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # 1 local device per process
+sys.path.insert(0, sys.argv[3])
+
+import jax
+jax.config.update("jax_platforms", "cpu")  # beat any sitecustomize override
+
+from znicz_tpu.parallel import multihost
+
+info = multihost.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+assert info["process_count"] == 2, info
+assert info["global_devices"] == 2, info
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# exactly-one-coordinator contract (reference: master does bookkeeping)
+flags = multihost_utils.process_allgather(
+    jnp.asarray([1.0 if multihost.is_coordinator() else 0.0])
+)
+assert float(np.sum(flags)) == 1.0, flags
+
+# jitted cross-process reduction over the global mesh
+mesh = Mesh(np.array(jax.devices()), ("data",))
+local = jnp.ones((4,)) * (jax.process_index() + 1)
+garr = multihost_utils.host_local_array_to_global_array(local, mesh, P("data"))
+total = jax.jit(
+    jnp.sum, out_shardings=NamedSharding(mesh, P())
+)(garr)
+assert float(total) == 12.0, float(total)  # 4*1 + 4*2
+print(f"OK process={jax.process_index()}")
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_localhost_rendezvous(tmp_path):
+    addr = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items() if k != "XLA_FLAGS"
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, addr, str(pid), REPO],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host worker timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err}"
+    assert any("OK process=0" in o for _, o, _ in outs)
+    assert any("OK process=1" in o for _, o, _ in outs)

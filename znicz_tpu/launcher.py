@@ -57,6 +57,24 @@ def make_parser() -> argparse.ArgumentParser:
                    help="write snapshots under this directory")
     p.add_argument("--data-parallel", action="store_true",
                    help="shard batches over all local devices")
+    p.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="device mesh spec, e.g. data=4,model=2 — shards "
+                        "batches over 'data' and weights over 'model' "
+                        "(tensor parallel); implies --data-parallel")
+    # multi-host bring-up (replaces the reference's --listen /
+    # --master-address master-slave pair, SURVEY.md 3.4): every host runs
+    # the SAME command with its own --process-id; the coordinator address
+    # is the rendezvous, not a data channel.
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host rendezvous address (jax.distributed); "
+                        "on TPU pod slices omit all three flags — topology "
+                        "autodetects")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--data-dir", default=None,
+                   help="dataset directory for the workflow's loader "
+                        "(sets root.common.data_dir; model modules fall "
+                        "back to it when their loader config has none)")
     p.add_argument("--stop-after", type=int, default=None, metavar="EPOCHS",
                    help="override the workflow's max_epochs")
     p.add_argument("--optimize", type=int, default=None, metavar="GENS",
@@ -87,12 +105,22 @@ class Launcher(Logger):
             dc = dict(wf_kwargs.get("decision_config") or {})
             dc["max_epochs"] = self.args.stop_after
             wf_kwargs["decision_config"] = dc
-        if self.args.data_parallel and "parallel" not in wf_kwargs:
+        if (
+            self.args.data_parallel or getattr(self.args, "mesh", None)
+        ) and "parallel" not in wf_kwargs:
             import inspect
 
-            from znicz_tpu.parallel import DataParallel
+            from znicz_tpu.parallel import (
+                MODEL_AXIS,
+                DataParallel,
+                mesh_from_spec,
+            )
 
-            dp = DataParallel()
+            if getattr(self.args, "mesh", None):
+                mesh = mesh_from_spec(self.args.mesh)
+                dp = DataParallel(mesh, tp=mesh.shape.get(MODEL_AXIS, 1) > 1)
+            else:
+                dp = DataParallel()
             # Signature check (not try/except TypeError): an unrelated
             # TypeError raised inside the constructor must propagate, not
             # silently retry without DP.
@@ -172,7 +200,8 @@ def run_args(argv=None) -> Launcher:
         # jax is imported by the package before CLI parsing and deployment
         # sitecustomize hooks may force a platform config, so an explicit
         # --device must go through jax.config (env vars are already ignored
-        # at this point).
+        # at this point).  MUST precede multihost.initialize(), which
+        # touches jax.devices() and freezes the backend choice.
         import jax
 
         # "tpu,axon": force an accelerator — either the native TPU plugin or
@@ -180,6 +209,21 @@ def run_args(argv=None) -> Launcher:
         jax.config.update(
             "jax_platforms", "cpu" if args.device == "cpu" else "tpu,axon"
         )
+    if args.coordinator or args.num_processes or args.process_id is not None:
+        from znicz_tpu.parallel import multihost
+
+        info = multihost.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        Logger().info(
+            "multi-host: process %d/%d, %d local / %d global devices",
+            info["process_index"], info["process_count"],
+            info["local_devices"], info["global_devices"],
+        )
+    if args.data_dir:
+        root.common.update({"data_dir": args.data_dir})
     launcher = Launcher(args)
     sys.path.insert(0, os.path.dirname(os.path.abspath(args.workflow)))
     module = _load_module(args.workflow, "__znicz_workflow__")

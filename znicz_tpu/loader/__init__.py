@@ -11,5 +11,6 @@ masked inside the jitted step instead of triggering recompilation
 from znicz_tpu.loader.base import TRAIN, VALID, TEST, Loader, Minibatch  # noqa: F401
 from znicz_tpu.loader.fullbatch import FullBatchLoader  # noqa: F401
 from znicz_tpu.loader.image import ImageDirectoryLoader  # noqa: F401
+from znicz_tpu.loader.imagenet import ImageNetLoader, pack_image_dir  # noqa: F401
 from znicz_tpu.loader import datasets  # noqa: F401
 from znicz_tpu.loader import normalizers  # noqa: F401

@@ -69,6 +69,24 @@ class Loader:
         """All labels of a split (enables ``balanced``); None if unknown."""
         return None
 
+    def device_preproc(self):
+        """Optional jit-safe callable ``pre(x, ctx)`` applied to the batch
+        ON DEVICE inside the compiled step (e.g. u8 -> f32 affine + mean
+        subtraction, or an HBM-pool gather).  Lets ``fill`` return uint8
+        minibatches (4x smaller host->device transfer) or bare index vectors
+        (device-resident datasets); the convert fuses into the XLA program.
+        ``ctx`` is the device-side pytree from :meth:`device_context` (None
+        when unused).  None = batches arrive ready."""
+        return None
+
+    def device_context(self):
+        """Host pytree of large loader-owned arrays the preproc needs on
+        device (e.g. the device-resident dataset pool).  The workflow
+        device_puts it ONCE at initialize and threads it through the jitted
+        step as an ARGUMENT — never a closure constant, which XLA would
+        embed into the compiled executable."""
+        return None
+
     # -- serving -----------------------------------------------------------
     def n_minibatches(self, split: str) -> int:
         n = self.class_lengths.get(split, 0)

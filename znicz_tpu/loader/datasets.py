@@ -236,12 +236,17 @@ def imagenet_synthetic(
     n_train: int = 512,
     n_valid: int = 128,
     minibatch_size: int = 128,
+    store_u8: bool = True,
     **loader_kwargs,
 ) -> FullBatchLoader:
     """Synthetic ImageNet-shaped data for the AlexNet workflow: the real
-    pipeline (resize/crop/mean-subtract, SURVEY.md 2.3) needs the dataset on
-    disk; shapes and class count here match so compiled programs are
-    identical."""
+    pipeline (``loader/imagenet.py``) needs the dataset on disk; shapes,
+    class count AND data path here match so compiled programs are identical.
+
+    ``store_u8`` (default): quantize to uint8 and convert/normalize
+    ON-DEVICE — the same u8 -> device -> fused-affine path the real packed
+    ImageNet loader uses, so benchmarks measure the production pipeline.
+    """
     data, labels = _synthetic_split(
         n_train,
         n_valid,
@@ -250,6 +255,18 @@ def imagenet_synthetic(
         test_split="valid",
         sep=1.0,
     )
+    if store_u8:
+        # affine-map the Gaussian blobs into 0..255 (class structure is
+        # affine-invariant); "range" 255/-0.5 then lands values in [-.5, .5]
+        data = {
+            k: np.clip((v + 5.0) * 25.5, 0, 255).astype(np.uint8)
+            for k, v in data.items()
+        }
+        loader_kwargs.setdefault("normalization", "range")
+        loader_kwargs.setdefault(
+            "normalization_kwargs", {"scale": 255.0, "shift": -0.5}
+        )
+        loader_kwargs.setdefault("device_convert", True)
     return FullBatchLoader(
         data, labels, minibatch_size=minibatch_size, **loader_kwargs
     )

@@ -74,6 +74,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         lib.normalize_rows_f32.argtypes = [
             f64p, ctypes.c_int64, ctypes.c_int64, f64p, f64p,
         ]
+        lib.crop_gather_u8.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i64p, i64p, i64p, u8p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, u8p,
+        ]
+        lib.gather_rows_u8_raw.argtypes = [
+            u8p, ctypes.c_int64, i64p, ctypes.c_int64, u8p,
+        ]
         _lib = lib
         return _lib
 
@@ -108,6 +116,70 @@ def gather_rows(data: np.ndarray, indices: np.ndarray) -> np.ndarray:
     out = np.empty((len(idx), flat.shape[1]), np.float32)
     lib.gather_rows_f32(flat, flat.shape[1], idx, len(idx), out)
     return out.reshape((len(idx),) + data.shape[1:])
+
+
+def gather_rows_u8_raw(data: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Plain u8 row gather (no conversion) — feeds the u8->device path
+    where the affine normalize runs on-device inside the XLA step."""
+    lib = _build_and_load()
+    flat = data.reshape(len(data), -1)
+    idx = _check_indices(indices, len(data))
+    if (
+        lib is None
+        or flat.dtype != np.uint8
+        or not flat.flags["C_CONTIGUOUS"]
+    ):
+        return data[idx]
+    out = np.empty((len(idx), flat.shape[1]), np.uint8)
+    lib.gather_rows_u8_raw(flat, flat.shape[1], idx, len(idx), out)
+    return out.reshape((len(idx),) + data.shape[1:])
+
+
+def crop_gather_u8(
+    data: np.ndarray,
+    indices: np.ndarray,
+    oy: np.ndarray,
+    ox: np.ndarray,
+    flip: np.ndarray,
+    out_h: int,
+    out_w: int,
+) -> np.ndarray:
+    """Fused gather + crop + optional horizontal flip from packed u8 images.
+
+    ``data``: [N, H, W, C] u8; per sample i the window at (oy[i], ox[i]) of
+    size (out_h, out_w) is copied (W-reversed when flip[i]).  Output stays u8;
+    normalization happens on-device.  Numpy fallback when the native library
+    is unavailable or ``data`` is non-contiguous/mmap-backed-but-fancy.
+    """
+    n, h, w, c = data.shape
+    idx = _check_indices(indices, n)
+    oy = np.ascontiguousarray(oy, np.int64)
+    ox = np.ascontiguousarray(ox, np.int64)
+    if oy.min(initial=0) < 0 or ox.min(initial=0) < 0 or (
+        idx.size
+        and (oy.max(initial=0) > h - out_h or ox.max(initial=0) > w - out_w)
+    ):
+        raise IndexError("crop window out of image bounds")
+    flip_u8 = np.ascontiguousarray(flip, np.uint8)
+    lib = _build_and_load()
+    # np.memmap works here too: the C side reads through page faults, which
+    # is exactly how a larger-than-RAM packed dataset streams from disk
+    if (
+        lib is not None
+        and data.dtype == np.uint8
+        and data.flags["C_CONTIGUOUS"]
+    ):
+        out = np.empty((len(idx), out_h, out_w, c), np.uint8)
+        lib.crop_gather_u8(
+            data.reshape(-1), h, w, c, idx, oy, ox, flip_u8, len(idx),
+            out_h, out_w, out.reshape(-1),
+        )
+        return out
+    out = np.empty((len(idx), out_h, out_w, c), data.dtype)
+    for i, j in enumerate(idx):
+        win = data[j, oy[i] : oy[i] + out_h, ox[i] : ox[i] + out_w]
+        out[i] = win[:, ::-1] if flip_u8[i] else win
+    return out
 
 
 def gather_rows_u8(

@@ -3,13 +3,19 @@
 Parity with ``znicz/samples/ImageNet/`` (AlexNet-class workflow,
 [SURVEY.md 2.3 "Samples"]; BASELINE.json north_star).  Canonical single-tower
 AlexNet geometry (227 input, 5 conv + 3 FC); bfloat16-friendly, NHWC, every
-conv/FC rides the MXU.  The real ImageNet pipeline needs the dataset on disk
-(``data_dir``); the synthetic stand-in keeps identical shapes so the compiled
-program — and therefore the benchmark — is the same.
+conv/FC rides the MXU.
+
+With ``loader.data_dir`` set (config file, or the launcher's ``--data-dir``
+flag), the real ImageNet pipeline runs: packed-u8 images streamed from disk,
+native random-crop-227 + horizontal flip, eval center crop, channel-mean
+subtraction fused on-device (``loader/imagenet.py``).  Without a data_dir the
+synthetic stand-in keeps identical shapes AND the identical u8->device->
+normalize data path, so the compiled program — and therefore the benchmark —
+matches the real-data run.
 """
 
 from znicz_tpu.core.config import root
-from znicz_tpu.loader import datasets
+from znicz_tpu.loader import ImageNetLoader, datasets
 from znicz_tpu.models import effective_config, merge_workflow_kwargs
 from znicz_tpu.workflow import StandardWorkflow
 
@@ -36,10 +42,12 @@ def _conv(n, k, *, sliding=(1, 1), padding=(0, 0, 0, 0)):
 
 DEFAULTS = {
     "loader": {
-        "image_size": 227,
+        "data_dir": None,  # packed or raw image dir -> real ImageNet path
+        "pack_size": 256,  # packed canonical size (resize short side, crop)
+        "image_size": 227,  # train-time random-crop size
         "n_classes": 1000,
         "minibatch_size": 128,
-        "n_train": 512,  # synthetic stand-in sizes
+        "n_train": 512,  # synthetic stand-in sizes (data_dir=None only)
         "n_valid": 128,
     },
     "layers": [
@@ -91,13 +99,25 @@ root.alexnet.update(DEFAULTS)
 def build_workflow(**overrides) -> StandardWorkflow:
     cfg = effective_config(root.alexnet, DEFAULTS)
     lcfg = cfg.loader
-    loader = datasets.imagenet_synthetic(
-        image_size=lcfg.get("image_size", 227),
-        n_classes=lcfg.get("n_classes", 1000),
-        n_train=lcfg.get("n_train", 512),
-        n_valid=lcfg.get("n_valid", 128),
-        minibatch_size=lcfg.get("minibatch_size", 128),
-    )
+    layers = cfg.get("layers")
+    data_dir = lcfg.get("data_dir") or root.common.get("data_dir")
+    if data_dir:
+        loader = ImageNetLoader(
+            data_dir,
+            crop_size=lcfg.get("image_size", 227),
+            pack_size=lcfg.get("pack_size", 256),
+            minibatch_size=lcfg.get("minibatch_size", 128),
+        )
+        # the classifier head must match the dataset's class count
+        layers[-1]["->"]["output_sample_shape"] = loader.n_classes()
+    else:
+        loader = datasets.imagenet_synthetic(
+            image_size=lcfg.get("image_size", 227),
+            n_classes=lcfg.get("n_classes", 1000),
+            n_train=lcfg.get("n_train", 512),
+            n_valid=lcfg.get("n_valid", 128),
+            minibatch_size=lcfg.get("minibatch_size", 128),
+        )
     kwargs = merge_workflow_kwargs(
         {
             "decision_config": cfg.decision.to_dict(),
@@ -107,7 +127,7 @@ def build_workflow(**overrides) -> StandardWorkflow:
         },
         overrides,
     )
-    return StandardWorkflow(loader, cfg.get("layers"), **kwargs)
+    return StandardWorkflow(loader, layers, **kwargs)
 
 
 def run(load, main):

@@ -57,7 +57,7 @@ def build_workflow(**overrides) -> StandardWorkflow:
     cfg = effective_config(root.cifar, DEFAULTS)
     lcfg = cfg.loader
     loader = datasets.cifar10(
-        lcfg.get("data_dir"),
+        lcfg.get("data_dir") or root.common.get("data_dir"),
         minibatch_size=lcfg.get("minibatch_size", 100),
         n_train=lcfg.get("n_train", 2000),
         n_test=lcfg.get("n_test", 500),

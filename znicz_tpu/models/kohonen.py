@@ -34,7 +34,7 @@ def build_workflow(**overrides) -> KohonenWorkflow:
     cfg = effective_config(root.kohonen, DEFAULTS)
     lcfg = cfg.loader
     loader = datasets.mnist(
-        lcfg.get("data_dir"),
+        lcfg.get("data_dir") or root.common.get("data_dir"),
         minibatch_size=lcfg.get("minibatch_size", 100),
         n_train=lcfg.get("n_train", 1000),
         n_test=lcfg.get("n_test", 200),

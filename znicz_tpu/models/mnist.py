@@ -48,7 +48,7 @@ def build_workflow(**overrides) -> StandardWorkflow:
     cfg = effective_config(root.mnist, DEFAULTS)
     lcfg = cfg.loader
     loader = datasets.mnist(
-        lcfg.get("data_dir"),
+        lcfg.get("data_dir") or root.common.get("data_dir"),
         minibatch_size=lcfg.get("minibatch_size", 100),
         validation_ratio=lcfg.get("validation_ratio", 0.0),
         n_train=lcfg.get("n_train", 2000),

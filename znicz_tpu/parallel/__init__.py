@@ -18,8 +18,11 @@ snapshotter.
 from znicz_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     data_sharding,
     make_mesh,
+    mesh_from_spec,
+    parse_mesh_spec,
     replicated,
 )
 from znicz_tpu.parallel.data_parallel import DataParallel  # noqa: F401

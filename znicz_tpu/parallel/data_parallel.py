@@ -42,10 +42,15 @@ class DataParallel:
         *,
         tp: bool = False,
         tp_min_features: int = 1024,
+        param_rules=None,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.tp = tp and self.mesh.shape[MODEL_AXIS] > 1
         self.tp_min_features = tp_min_features
+        # param_rules: callable (path_str, leaf) -> PartitionSpec or None.
+        # Explicit model-aware placement (e.g. the transformer's QKV-head /
+        # row-column FFN rules) — None falls through to the size heuristic.
+        self.param_rules = param_rules
 
     @property
     def n_data(self) -> int:
@@ -66,6 +71,10 @@ class DataParallel:
 
     # -- params ------------------------------------------------------------
     def _param_spec(self, path: str, leaf) -> P:
+        if self.param_rules is not None:
+            spec = self.param_rules(path, leaf)
+            if spec is not None:
+                return spec
         if (
             self.tp
             and hasattr(leaf, "ndim")

@@ -16,30 +16,81 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
 
 
 def make_mesh(
     n_data: Optional[int] = None,
     n_model: int = 1,
+    n_pipe: int = 1,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (data, model) mesh over the given (default: all) devices.
+    """Build a (data, model[, pipe]) mesh over the given (default: all)
+    devices.
 
-    ``n_data=None`` uses every remaining device on the data axis.  On real
-    hardware callers should order devices so the model axis rides the
-    fastest ICI links; here we take jax's default device order.
+    ``n_data=None`` uses every remaining device on the data axis.  The
+    ``pipe`` axis only appears when ``n_pipe > 1`` (size-1 extra axes are
+    harmless to GSPMD but noisy to read).  On real hardware callers should
+    order devices so the model axis rides the fastest ICI links; here we
+    take jax's default device order.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
-        n_data = len(devices) // n_model
-    if n_data * n_model > len(devices):
+        n_data = len(devices) // (n_model * n_pipe)
+    need = n_data * n_model * n_pipe
+    if need > len(devices) or need < 1:
         raise ValueError(
-            f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, "
+            f"mesh {n_data}x{n_model}x{n_pipe} needs {need} devices, "
             f"have {len(devices)}"
         )
-    grid = np.array(devices[: n_data * n_model]).reshape(n_data, n_model)
+    if n_pipe > 1:
+        grid = np.array(devices[:need]).reshape(n_data, n_model, n_pipe)
+        return Mesh(grid, (DATA_AXIS, MODEL_AXIS, PIPE_AXIS))
+    grid = np.array(devices[:need]).reshape(n_data, n_model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def parse_mesh_spec(spec: str) -> dict:
+    """Parse the CLI/config mesh syntax ``"data=4,model=2,pipe=1"``.
+
+    Axis names follow the framework's canonical mesh (SURVEY.md 3.4
+    replacement): ``data`` shards batches, ``model`` shards weights
+    (TP/EP), ``pipe`` shards pipeline stages.  Returns axis->size.
+    """
+    sizes = {}
+    for part in spec.replace(" ", "").split(","):
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad mesh spec entry {part!r}: want axis=size "
+                "(e.g. data=4,model=2)"
+            )
+        name, _, val = part.partition("=")
+        if name not in (DATA_AXIS, MODEL_AXIS, PIPE_AXIS):
+            raise ValueError(
+                f"unknown mesh axis {name!r}: valid axes are "
+                f"{DATA_AXIS}/{MODEL_AXIS}/{PIPE_AXIS}"
+            )
+        sizes[name] = int(val)
+        if sizes[name] < 1:
+            raise ValueError(f"mesh axis {name} must be >= 1")
+    return sizes
+
+
+def mesh_from_spec(
+    spec: str, *, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """``"data=4,model=2"`` -> Mesh (unlisted axes default to 1; ``data``
+    with no explicit size soaks up the remaining devices)."""
+    sizes = parse_mesh_spec(spec)
+    return make_mesh(
+        sizes.get(DATA_AXIS),
+        sizes.get(MODEL_AXIS, 1),
+        sizes.get(PIPE_AXIS, 1),
+        devices=devices,
+    )
 
 
 def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:

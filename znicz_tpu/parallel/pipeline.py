@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-PIPE_AXIS = "pipe"
+from znicz_tpu.parallel.mesh import PIPE_AXIS  # noqa: F401  (canonical axis)
 
 
 def stack_stage_params(per_stage_params) -> Any:

@@ -24,10 +24,12 @@ from znicz_tpu.nn import optimizer
 from znicz_tpu.ops import (
     activation as act_op,
     all2all,
+    attention as attention_op,
     conv,
     cutter,
     deconv,
     dropout as dropout_op,
+    moe as moe_op,
     normalization,
     pooling,
 )
@@ -99,6 +101,14 @@ _INIT_KEYS = (
 
 def _init_kwargs(fwd: dict) -> dict:
     return {k: fwd[k] for k in _INIT_KEYS if k in fwd}
+
+
+def _init_kwargs_moe(fwd: dict) -> dict:
+    return {
+        k: fwd[k]
+        for k in ("weights_stddev", "weights_filling")
+        if k in fwd
+    }
 
 
 def build(
@@ -246,10 +256,59 @@ def build(
             def fn(p, x, train, rng, a=a):
                 return a(x)
 
+        elif kind == "moe":
+            # residual mixture-of-experts FFN (ops/moe.py): works on [B, F]
+            # activations or per-token on [B, T, D] sequences.  Output dim ==
+            # input dim, combined residually, so it drops into any stack.
+            d = shape[-1] if len(shape) == 3 else int(np.prod(shape[1:]))
+            n_experts = int(fwd["n_experts"])
+            n_hidden = int(fwd.get("n_hidden", 4 * d))
+            top_k = int(fwd.get("top_k", 1))
+            residual = bool(fwd.get("residual", True))
+            p = moe_op.init_params(
+                d, n_hidden, n_experts,
+                rand_name=rand_name, **_init_kwargs_moe(fwd),
+            )
+
+            def fn(p, x, train, rng, k=top_k, res=residual):
+                if x.ndim == 3:  # per-token on sequences
+                    b, t, dd = x.shape
+                    y = moe_op.apply(
+                        p, x.reshape(b * t, dd), top_k=k
+                    ).reshape(b, t, dd)
+                    return x + y if res else y
+                flat = x.reshape(x.shape[0], -1)
+                y = moe_op.apply(p, flat, top_k=k)
+                return flat + y if res else y
+
+            if len(shape) != 3:  # flattened-token path emits [B, d]
+                shape = (shape[0], d)
+
+        elif kind == "attention":
+            # pre-LN residual multi-head self-attention block
+            # (ops/attention.py): per-sample input must be [T, D]
+            if len(shape) != 3:
+                raise ValueError(
+                    f"layer {i} (attention) needs [T, D] per-sample input, "
+                    f"got shape {shape}"
+                )
+            d = shape[2]
+            n_heads = int(fwd.get("n_heads", 4))
+            causal = bool(fwd.get("causal", True))
+            p = attention_op.init_mha_params(
+                d, n_heads, rand_name=rand_name, **_init_kwargs(fwd)
+            )
+            p["ln_scale"] = jnp.ones((d,))
+            p["ln_bias"] = jnp.zeros((d,))
+
+            def fn(p, x, train, rng, nh=n_heads, c=causal):
+                h = normalization.layer_norm(x, p["ln_scale"], p["ln_bias"])
+                return x + attention_op.mha(p, h, n_heads=nh, causal=c)
+
         else:
             raise ValueError(
                 f"unknown layer type {kind!r} at index {i}; known: "
-                f"{sorted(_A2A_ACT) + sorted(_CONV_ACT) + sorted(_POOL) + ['softmax', 'stochastic_pooling', 'deconv', 'norm', 'dropout', 'cutter', 'activation_*']}"
+                f"{sorted(_A2A_ACT) + sorted(_CONV_ACT) + sorted(_POOL) + ['softmax', 'stochastic_pooling', 'deconv', 'norm', 'dropout', 'cutter', 'moe', 'attention', 'activation_*']}"
             )
 
         params.append(p)

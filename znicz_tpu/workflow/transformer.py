@@ -28,6 +28,7 @@ from znicz_tpu.nn.decision import Decision
 from znicz_tpu.nn.train_state import TrainState
 from znicz_tpu.ops import attention
 from znicz_tpu.ops.filling import fill
+from znicz_tpu.parallel.mesh import MODEL_AXIS
 from znicz_tpu.ops.normalization import layer_norm
 from znicz_tpu.workflow.snapshotter import Snapshotter
 from znicz_tpu.workflow.workflow import Workflow
@@ -98,6 +99,29 @@ def lm_apply(params, tokens, *, n_heads, attention_fn=None):
     return x @ params[-1]["head"]
 
 
+def lm_tp_rules(path: str, leaf):
+    """Head/row-column-aware tensor-parallel placement for the LM params
+    (plugs into ``DataParallel(param_rules=...)``).
+
+    Column-parallel (shard the output-features dim over ``model``): the QKV
+    projections — the inner dim is heads*head_dim, so this IS head sharding
+    when n_heads divides the axis — plus ``w_up`` and the vocab dim of the
+    ``head`` (the loss's log-softmax reduces over it with a psum GSPMD
+    inserts).  Row-parallel (shard the input dim; XLA psums the partial
+    products): ``wo`` and ``w_down``.  Everything else (embeddings, layer
+    norms, biases except up_bias) is replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if any(k in path for k in ("'wq'", "'wk'", "'wv'", "'w_up'", "'head'")):
+        return P(None, MODEL_AXIS)
+    if any(k in path for k in ("'wo'", "'w_down'")):
+        return P(MODEL_AXIS, None)
+    if "'up_bias'" in path:
+        return P(MODEL_AXIS)
+    return P()
+
+
 class TransformerLMWorkflow(Workflow):
     """Next-token LM training over integer-sequence loaders.
 
@@ -106,6 +130,10 @@ class TransformerLMWorkflow(Workflow):
 
     ``sequence_parallel``: shard the sequence axis over a mesh's data axis
     with ring attention (set ``parallel`` too for the batch placement).
+    ``tensor_parallel``: shard attention heads + FFN + vocab head over the
+    mesh's ``model`` axis (``lm_tp_rules``); composes with DP and SP on the
+    same mesh.  Requires ``parallel=DataParallel(mesh)`` with a model axis
+    > 1 and n_heads divisible by it.
     """
 
     def __init__(
@@ -119,6 +147,7 @@ class TransformerLMWorkflow(Workflow):
         max_epochs: int = 10,
         hyper: Optional[optimizer.HyperParams] = None,
         sequence_parallel: bool = False,
+        tensor_parallel: bool = False,
         mesh=None,
         decision: Optional[Decision] = None,
         snapshotter: Optional[Snapshotter] = None,
@@ -153,8 +182,36 @@ class TransformerLMWorkflow(Workflow):
         )
         self.rand_name = rand_name
         self.sequence_parallel = sequence_parallel
+        self.tensor_parallel = tensor_parallel
         self.mesh = mesh
         self.max_seq = int(loader.sample_shape[0])
+        if tensor_parallel:
+            from znicz_tpu.parallel import DataParallel
+
+            if not isinstance(self.parallel, DataParallel):
+                raise ValueError(
+                    "tensor_parallel=True needs parallel=DataParallel(mesh) "
+                    "with a model axis"
+                )
+            n_model = self.parallel.mesh.shape.get(MODEL_AXIS, 1)
+            if n_model <= 1:
+                raise ValueError(
+                    "tensor_parallel=True but the mesh's model axis is 1"
+                )
+            if n_heads % n_model:
+                raise ValueError(
+                    f"n_heads={n_heads} not divisible by model axis {n_model}"
+                )
+            if self.parallel.param_rules is None:
+                # never mutate the caller's DataParallel (it may be shared
+                # with workflows whose params want the size heuristic —
+                # lm_tp_rules replicates everything it doesn't recognize)
+                self.parallel = DataParallel(
+                    self.parallel.mesh,
+                    tp=self.parallel.tp,
+                    tp_min_features=self.parallel.tp_min_features,
+                    param_rules=lm_tp_rules,
+                )
 
     def _batch_target(self, mb):
         return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
@@ -219,9 +276,11 @@ class TransformerLMWorkflow(Workflow):
             _, metrics = loss_metrics(params, x, mask)
             return metrics
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
-        self._eval_step = jax.jit(eval_step)
-        self._eval_conf_step = None
+        self._finalize_steps(
+            train_step,
+            eval_step,
+            ["loss", "n_samples", "n_err", "token_accuracy"],
+        )
 
     def _create_initial_state(self) -> TrainState:
         params = init_lm_params(

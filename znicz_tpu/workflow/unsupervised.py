@@ -133,8 +133,9 @@ class KohonenWorkflow(Workflow):
             win = kh.winners(params, x)
             return self._qe(params, x, win, mask)
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
-        self._eval_step = jax.jit(eval_step)
+        self._finalize_steps(
+            train_step, eval_step, ["loss", "n_samples", "n_err"]
+        )
 
     @staticmethod
     def _qe(params, x, win, mask):
@@ -231,8 +232,9 @@ class RBMWorkflow(Workflow):
                 "n_err": jnp.zeros((), jnp.int32),
             }
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
-        self._eval_step = jax.jit(eval_step)
+        self._finalize_steps(
+            train_step, eval_step, ["loss", "n_samples", "n_err"]
+        )
 
     def _create_initial_state(self) -> TrainState:
         params = rbm_op.init_params(

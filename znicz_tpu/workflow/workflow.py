@@ -30,6 +30,40 @@ from znicz_tpu.workflow.model import Model
 from znicz_tpu.workflow.snapshotter import Snapshotter
 
 
+def _is_additive(name: str) -> bool:
+    return not name.startswith("max_")
+
+
+def _encode_metrics(m: Dict[str, Any], names) -> jnp.ndarray:
+    """Metric dict -> epoch-accumulator increments, INSIDE the jitted step.
+
+    Mirrors :class:`znicz_tpu.nn.decision.EpochMetrics` semantics: counts
+    add, means add sample-weighted, ``max_*`` metrics combine by maximum.
+    """
+    n = jnp.asarray(m["n_samples"], jnp.float32)
+    vals = []
+    for k in names:
+        v = jnp.asarray(m[k], jnp.float32)
+        if k in ("n_samples", "n_err") or not _is_additive(k):
+            vals.append(v)
+        else:  # sample-weighted sum; decoded back to a mean at epoch end
+            vals.append(v * n)
+    return jnp.stack(vals)
+
+
+def _decode_metrics(acc: np.ndarray, names) -> Dict[str, float]:
+    """Accumulator vector -> ONE aggregated metrics dict whose
+    ``EpochMetrics.add`` outcome equals adding every minibatch."""
+    d = dict(zip(names, np.asarray(acc, np.float64)))
+    n = max(float(d.get("n_samples", 0.0)), 1.0)
+    return {
+        k: float(v)
+        if k in ("n_samples", "n_err") or not _is_additive(k)
+        else float(v) / n
+        for k, v in d.items()
+    }
+
+
 class Workflow(Logger):
     """Owns loader + model + decision + snapshotter; runs training.
 
@@ -69,6 +103,7 @@ class Workflow(Logger):
         self._train_step = None
         self._eval_step = None
         self._eval_conf_step = None
+        self._ctx = None
         self._host_step = 0
         from znicz_tpu.utils.profiling import StepTimer
 
@@ -82,16 +117,26 @@ class Workflow(Logger):
 
     def _build_steps(self):
         model = self.model
+        # loader-provided on-device preprocessing (u8 -> f32 affine, mean
+        # subtraction, HBM-pool gather): fuses into the XLA program, so
+        # minibatches cross host->device as u8 (1/4 the bytes of f32) or as
+        # bare index vectors (device-resident datasets)
+        pre = self.loader.device_preproc()
+        target_is_input = self.target == "input"
 
-        def loss_fn(params, key, step, x, y, mask):
+        def loss_fn(params, key, step, x, y, mask, ctx):
+            if pre is not None:
+                x = pre(x, ctx)
+                if target_is_input:  # AE target is the preprocessed input
+                    y = x
             rng = jax.random.fold_in(key, step)
             out = model.apply(params, x, train=True, rng=rng)
             m = self._metrics(out, y, mask)
             return m["loss"], m
 
-        def train_step(state: TrainState, x, y, mask, lr_scale):
+        def train_step(state: TrainState, x, y, mask, lr_scale, ctx):
             grads, metrics = jax.grad(loss_fn, has_aux=True)(
-                state.params, state.key, state.step, x, y, mask
+                state.params, state.key, state.step, x, y, mask, ctx
             )
             hyper = [
                 h._replace(
@@ -114,22 +159,100 @@ class Workflow(Logger):
                 metrics,
             )
 
-        def eval_step(params, x, y, mask):
+        def eval_step(params, x, y, mask, ctx):
+            if pre is not None:
+                x = pre(x, ctx)
+                if target_is_input:
+                    y = x
             out = model.apply(params, x, train=False)
             return self._metrics(out, y, mask)
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
-        self._eval_step = jax.jit(eval_step)
         if self.loss_function == "softmax":
             from znicz_tpu.nn import evaluator as _ev
 
-            def eval_conf_step(params, x, y, mask):
+            def eval_conf_step(params, x, y, mask, ctx):
+                if pre is not None:
+                    x = pre(x, ctx)
                 out = model.apply(params, x, train=False)
                 return _ev.softmax(out, y, mask=mask, compute_confusion=True)
 
-            self._eval_conf_step = jax.jit(eval_conf_step)
+            names = ["loss", "max_err_y_sum", "n_err", "n_samples"]
+        else:
+            eval_conf_step = None
+            names = ["loss", "max_diff", "n_samples"]
+        self._finalize_steps(
+            train_step, eval_step, names,
+            eval_conf_step=eval_conf_step, needs_ctx=True,
+        )
+
+    def _finalize_steps(
+        self,
+        train_step,
+        eval_step,
+        metric_names,
+        *,
+        eval_conf_step=None,
+        needs_ctx=False,
+    ):
+        """Jit the raw steps with ON-DEVICE epoch-metric accumulation.
+
+        ``train_step(state, x, y, mask, lr_scale) -> (state, metrics_dict)``
+        and ``eval_step(params, x, y, mask) -> metrics_dict`` are wrapped so
+        the compiled program also folds each batch's metrics into a single
+        f32 accumulator vector.  The epoch then needs exactly ONE small
+        device->host fetch per split — O(1) host syncs per epoch on pods,
+        and immune to the seconds-per-round-trip cost of remote-relay
+        transports.  No extra XLA programs are created (the combine lives
+        inside the step; the init vector is a plain device_put).
+        """
+        names = sorted(metric_names)
+        self._metric_names = names
+        is_additive = np.array([_is_additive(k) for k in names])
+        self._acc_init_host = np.where(
+            is_additive, 0.0, -np.inf
+        ).astype(np.float32)
+        add_mask = jnp.asarray(is_additive)
+
+        def combine(acc, m):
+            vec = _encode_metrics(m, names)
+            return jnp.where(add_mask, acc + vec, jnp.maximum(acc, vec))
+
+        # ``ctx`` is the loader's device_context (e.g. the HBM-resident
+        # dataset pool) — always an explicit jit ARGUMENT so XLA never
+        # embeds it in the executable; steps that predate the ctx arg
+        # (transformer, SOM/RBM) simply don't receive it.
+        def train_acc(state, x, y, mask, lr_scale, acc, ctx):
+            args = (state, x, y, mask, lr_scale) + ((ctx,) if needs_ctx else ())
+            state2, m = train_step(*args)
+            return state2, combine(acc, m)
+
+        def eval_acc(params, x, y, mask, acc, ctx):
+            args = (params, x, y, mask) + ((ctx,) if needs_ctx else ())
+            return combine(acc, eval_step(*args))
+
+        # un-jitted step kept public: benchmarks/tools can embed it in their
+        # own compiled programs (e.g. a lax.fori_loop of steps for device-
+        # side latency measurement without per-step dispatch overhead)
+        self.train_step_fn = train_step
+        self._train_step = jax.jit(train_acc, donate_argnums=(0, 5))
+        self._eval_step = jax.jit(eval_acc, donate_argnums=(4,))
+        if eval_conf_step is not None:
+
+            def eval_conf_acc(params, x, y, mask, acc, conf, ctx):
+                args = (params, x, y, mask) + ((ctx,) if needs_ctx else ())
+                m = eval_conf_step(*args)
+                c = m.pop("confusion")
+                return combine(acc, m), conf + c
+
+            self._eval_conf_step = jax.jit(
+                eval_conf_acc, donate_argnums=(4, 5)
+            )
         else:
             self._eval_conf_step = None
+
+    def _acc_init(self) -> jax.Array:
+        """Fresh epoch accumulator (plain transfer — no compile)."""
+        return jax.device_put(self._acc_init_host.copy())
 
     # ------------------------------------------------------------------
     def _create_initial_state(self) -> TrainState:
@@ -169,6 +292,10 @@ class Workflow(Logger):
         # host-side mirror of state.step: lr policies read it every minibatch
         # and must not force a device sync in the hot loop
         self._host_step = int(self.state.step)
+        # loader-owned device context (e.g. HBM-resident dataset pool):
+        # ONE up-front transfer, threaded through every step as an argument
+        ctx_host = self.loader.device_context()
+        self._ctx = None if ctx_host is None else jax.device_put(ctx_host)
         self._build_steps()
 
     def _batch_target(self, mb):
@@ -197,17 +324,16 @@ class Workflow(Logger):
         """One full epoch over all splits; returns the Decision verdict."""
         if self.state is None:
             self.initialize()
-        pending = []  # (split, device-side metrics) — sync once at epoch end
+        accs: Dict[str, jax.Array] = {}  # per-split on-device accumulators
         put = (
             self.parallel.shard_batch if self.parallel is not None else jnp.asarray
         )
-        epoch_iter = self.loader.epoch()
-        if self.prefetch_batches:
-            from znicz_tpu.loader.prefetch import prefetch
 
-            epoch_iter = prefetch(epoch_iter, self.prefetch_batches)
-        for split, mb in epoch_iter:
-            with self.timer.phase(f"dispatch/{split}"):
+        def staged(it):
+            """Host gather AND device_put per batch; running this inside the
+            prefetch worker overlaps the host->device transfer with the
+            previous step's compute (device_put is thread-safe and async)."""
+            for split, mb in it:
                 x = put(mb.data)
                 # autoencoder target IS the input: reuse the device array
                 # instead of transferring the batch twice
@@ -216,24 +342,39 @@ class Workflow(Logger):
                     if self.target == "input"
                     else put(self._batch_target(mb))
                 )
-                mask = put(mb.mask)
+                yield split, x, y, put(mb.mask)
+
+        epoch_iter = staged(self.loader.epoch())
+        if self.prefetch_batches:
+            from znicz_tpu.loader.prefetch import prefetch
+
+            epoch_iter = prefetch(epoch_iter, self.prefetch_batches)
+        for split, x, y, mask in epoch_iter:
+            with self.timer.phase(f"dispatch/{split}"):
+                acc = accs.get(split)
+                if acc is None:
+                    acc = self._acc_init()
                 if split == TRAIN:
                     lr_scale = (
                         self.lr_policy(1.0, self._host_step)
                         if self.lr_policy
                         else 1.0
                     )
-                    self.state, metrics = self._train_step(
-                        self.state, x, y, mask, lr_scale
+                    self.state, acc = self._train_step(
+                        self.state, x, y, mask, lr_scale, acc, self._ctx
                     )
                     self._host_step += 1
                 else:
-                    metrics = self._eval_step(self.state.params, x, y, mask)
-            pending.append((split, metrics))
+                    acc = self._eval_step(
+                        self.state.params, x, y, mask, acc, self._ctx
+                    )
+                accs[split] = acc
         with self.timer.phase("metrics_sync"):
-            for split, metrics in jax.device_get(pending):
+            # one tiny existing-buffer fetch per split (no per-batch syncs)
+            for split, acc in accs.items():
                 self.decision.add_minibatch(
-                    split, {k: float(v) for k, v in metrics.items()}
+                    split,
+                    _decode_metrics(jax.device_get(acc), self._metric_names),
                 )
         verdict = self.decision.on_epoch_end()
         if self.snapshotter is not None:
@@ -261,10 +402,6 @@ class Workflow(Logger):
         """
         if self.state is None:
             self.initialize()
-        n_err = 0.0
-        loss_sum = 0.0
-        n = 0.0
-        conf = None
         use_conf = (
             confusion
             and self.loss_function == "softmax"
@@ -277,29 +414,35 @@ class Workflow(Logger):
             if self.parallel is not None
             else jnp.asarray
         )
-        pending = []
+        acc = self._acc_init()
+        conf = None
         for mb in self.loader.batches(split, shuffle=False):
             x = put(mb.data)
             y = x if self.target == "input" else put(self._batch_target(mb))
             mask = put(mb.mask)
-            step = self._eval_conf_step if use_conf else self._eval_step
-            pending.append(step(self.state.params, x, y, mask))
-        for m in jax.device_get(pending):  # one sync for the whole split
             if use_conf:
-                c = np.asarray(m["confusion"])
-                conf = c if conf is None else conf + c
-            k = float(m["n_samples"])
-            n += k
-            n_err += float(m.get("n_err", 0.0))
-            loss_sum += float(m["loss"]) * k
+                if conf is None:
+                    nc = int(np.prod(self.model.output_shape))
+                    conf = jax.device_put(np.zeros((nc, nc), np.int32))
+                acc, conf = self._eval_conf_step(
+                    self.state.params, x, y, mask, acc, conf, self._ctx
+                )
+            else:
+                acc = self._eval_step(
+                    self.state.params, x, y, mask, acc, self._ctx
+                )
+        # one (or two, with confusion) existing-buffer syncs for the split
+        m = _decode_metrics(jax.device_get(acc), self._metric_names)
+        n = m.get("n_samples", 0.0)
+        n_err = m.get("n_err", 0.0)
         result = {
             "n_samples": n,
             "n_err": n_err,
             "err_pct": 100.0 * n_err / max(n, 1.0),
-            "loss": loss_sum / max(n, 1.0),
+            "loss": m.get("loss", 0.0),
         }
         if conf is not None:
-            result["confusion"] = conf
+            result["confusion"] = np.asarray(jax.device_get(conf))
         return result
 
     def run(self) -> Decision:
