@@ -128,9 +128,11 @@ def main() -> None:
 
     n_epoch_imgs = int(os.environ.get("BENCH_EPOCH_IMAGES", str(8 * batch)))
     gen = np.random.default_rng(0)
+    # dtype=uint8 up front: the default int64 would transiently be 8x the
+    # final array (~GBs at default sizes)
     images_u8 = gen.integers(
-        0, 256, (n_epoch_imgs, 227, 227, 3)
-    ).astype(np.uint8)
+        0, 256, (n_epoch_imgs, 227, 227, 3), dtype=np.uint8
+    )
     labels = gen.integers(0, 1000, n_epoch_imgs).astype(np.int32)
 
     def epoch_rate(device_resident: bool, n_epochs: int) -> float:

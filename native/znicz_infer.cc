@@ -311,6 +311,72 @@ Tensor pool2d(const Tensor& x, int kx, int ky, int sx, int sy, bool is_max,
   return y;
 }
 
+// Deconv (transposed conv): the exact adjoint of conv2d with the same
+// geometry — matches znicz_tpu/ops/deconv.py (minimal-inverse output size).
+// x [N, OH, OW, K]; w [ky, kx, C, K]; out [N, H, W, C] with
+// H = (OH-1)*sy + ky - top - bottom (scatter-add formulation).
+Tensor deconv2d(const Tensor& x, const float* w, int kx, int ky,
+                int n_channels, int sx, int sy, Padding pad) {
+  int n = x.dim(0), oh = x.dim(1), ow = x.dim(2), k = x.dim(3);
+  int h = (oh - 1) * sy + ky - pad.top - pad.bottom;
+  int wd = (ow - 1) * sx + kx - pad.left - pad.right;
+  if (h <= 0 || wd <= 0)
+    throw std::runtime_error("deconv: padding exceeds reconstructed size");
+  Tensor y;
+  y.shape = {n, h, wd, n_channels};
+  y.data.assign(static_cast<size_t>(n) * h * wd * n_channels, 0.0f);
+  for (int ni = 0; ni < n; ++ni) {
+    for (int oy = 0; oy < oh; ++oy) {
+      for (int ox = 0; ox < ow; ++ox) {
+        const float* in = x.data.data() +
+            ((static_cast<int64_t>(ni) * oh + oy) * ow + ox) * k;
+        for (int dy = 0; dy < ky; ++dy) {
+          int iy = oy * sy + dy - pad.top;
+          if (iy < 0 || iy >= h) continue;
+          for (int dx = 0; dx < kx; ++dx) {
+            int ix = ox * sx + dx - pad.left;
+            if (ix < 0 || ix >= wd) continue;
+            float* out = y.data.data() +
+                ((static_cast<int64_t>(ni) * h + iy) * wd + ix) * n_channels;
+            const float* wk = w +
+                (static_cast<int64_t>(dy) * kx + dx) * n_channels * k;
+            for (int ci = 0; ci < n_channels; ++ci) {
+              const float* wc = wk + static_cast<int64_t>(ci) * k;
+              float acc = 0.0f;
+              for (int ki = 0; ki < k; ++ki) acc += in[ki] * wc[ki];
+              out[ci] += acc;
+            }
+          }
+        }
+      }
+    }
+  }
+  return y;
+}
+
+// Cutter: crop (left, top, right, bottom) — matches znicz_tpu/ops/cutter.py
+Tensor cut(const Tensor& x, Padding pad) {
+  int n = x.dim(0), h = x.dim(1), w = x.dim(2), c = x.dim(3);
+  int oh = h - pad.top - pad.bottom;
+  int ow = w - pad.left - pad.right;
+  if (oh <= 0 || ow <= 0)
+    throw std::runtime_error("cutter: padding exceeds input size");
+  Tensor y;
+  y.shape = {n, oh, ow, c};
+  y.data.resize(static_cast<size_t>(n) * oh * ow * c);
+  for (int ni = 0; ni < n; ++ni)
+    for (int oy = 0; oy < oh; ++oy)
+      for (int ox = 0; ox < ow; ++ox) {
+        const float* in = x.data.data() +
+            ((static_cast<int64_t>(ni) * h + oy + pad.top) * w + ox +
+             pad.left) * c;
+        float* out = y.data.data() +
+            ((static_cast<int64_t>(ni) * oh + oy) * ow + ox) * c;
+        std::memcpy(out, in, sizeof(float) * c);
+      }
+  return y;
+}
+
 // Cross-channel LRN, SAME window (matches ops/normalization.py)
 Tensor lrn(const Tensor& x, float alpha, float beta, float k, int n_window) {
   Tensor y = x;
@@ -465,6 +531,23 @@ struct Model {
           bool is_max = (t == "max_pooling" || t == "maxabs_pooling");
           x = pool2d(x, kx, ky, sx, sy, is_max, t == "maxabs_pooling");
         }
+      } else if (t == "deconv") {
+        const auto& wp = layer.params.at("weights");
+        if (wp.first.size() != 4)
+          throw std::runtime_error(
+              "layer 'deconv': weights must be rank 4 [ky,kx,C,K]");
+        int ky = wp.first[0], kx = wp.first[1], n_channels = wp.first[2];
+        if (x.shape.size() != 4 || x.dim(3) != wp.first[3])
+          throw std::runtime_error(
+              "layer 'deconv': input channels do not match weights");
+        int sx, sy;
+        read_sliding(cfg, &sx, &sy, 1, 1);
+        x = deconv2d(x, wp.second, kx, ky, n_channels, sx, sy,
+                     read_padding(cfg));
+      } else if (t == "cutter") {
+        if (x.shape.size() != 4)
+          throw std::runtime_error("layer 'cutter': input must be NHWC");
+        x = cut(x, read_padding(cfg));
       } else if (t == "norm") {
         float alpha = cfg.has("alpha") ? cfg.at("alpha").as_float() : 1e-4f;
         float beta = cfg.has("beta") ? cfg.at("beta").as_float() : 0.75f;

@@ -58,6 +58,29 @@ class TestEnsemble:
         l1 = ens.workflows[1].loader.labels["train"]
         np.testing.assert_array_equal(l0, l1)
 
+    def test_train_from_module_concurrent_matches_serial(self, tmp_path):
+        # process-level ensemble training (reference veles/ensemble mode):
+        # deterministic given seeds, identical for every worker count
+        from znicz_tpu.ensemble import train_from_module
+
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text("from znicz_tpu.models.wine import run\n")
+        kw = dict(n_models=2, base_seed=90, stop_after=2)
+        ens2 = train_from_module(str(wf_py), n_workers=2, **kw)
+        ens1 = train_from_module(str(wf_py), n_workers=1, **kw)
+        b2 = [d.best_value for d in ens2.decisions]
+        b1 = [d.best_value for d in ens1.decisions]
+        assert b2 == b1 and all(np.isfinite(v) for v in b2)
+        # members differ by init (different seeds), not by task
+        w0 = np.asarray(ens2.workflows[0].state.params[0]["weights"])
+        w1 = np.asarray(ens2.workflows[1].state.params[0]["weights"])
+        assert not np.allclose(w0, w1)
+        # aggregation works on the grafted member params
+        x = ens2.workflows[0].loader.data["train"][:8]
+        assert ens2.predict(x, vote="soft").shape == (8,)
+        result = ens2.evaluate("train")
+        assert 0.0 <= result["ensemble_err_pct"] <= 100.0
+
     def test_soft_and_hard_vote_shapes(self):
         ens = Ensemble(_build, n_models=2, base_seed=60)
         ens.train()

@@ -64,6 +64,88 @@ class TestGeneticOptimizer:
         assert run_once() == run_once()
 
 
+class TestConcurrentOptimize:
+    def test_batch_evaluation_is_generationwise_and_concurrent(self):
+        # the GA hands the WHOLE uncached generation to evaluate_batch at
+        # once — concurrency happens there (wall-clock scaling check)
+        import time
+        from concurrent.futures import ThreadPoolExecutor
+
+        calls = []
+
+        def eval_batch(genomes):
+            calls.append(len(genomes))
+            with ThreadPoolExecutor(4) as ex:
+                return list(
+                    ex.map(
+                        lambda g: (time.sleep(0.2), g[0] ** 2)[1], genomes
+                    )
+                )
+
+        prng.seed_all(5)
+        tunables = [({}, "x", Tune(0.0, -5.0, 5.0))]
+        opt = GeneticOptimizer(
+            None, tunables, population_size=8, evaluate_batch=eval_batch
+        )
+        t0 = time.time()
+        result = opt.run(generations=1)
+        dt = time.time() - t0
+        assert max(calls) >= 4  # generation-sized batches, not per-genome
+        assert dt < 8 * 0.2 * 0.8, dt  # faster than sequential => concurrent
+        assert np.isfinite(result["best_fitness"])
+
+    def test_worker_processes_deterministic_and_worker_count_invariant(
+        self, tmp_path
+    ):
+        # VERDICT r1 #5 gate: N-way concurrent --optimize, deterministic
+        # given seeds — and identical for every worker count
+        from znicz_tpu.genetics import optimize_workflow
+        from znicz_tpu.launcher import Launcher, _load_module, make_parser
+
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.core.config import root\n"
+            "from znicz_tpu.genetics import Tune\n"
+            "import znicz_tpu.models.wine as wine\n"
+            "root.wine.update({'lr': Tune(0.3, 0.05, 0.5)})\n"
+            "def run(load, main):\n"
+            "    lr = root.wine.get('lr')\n"
+            "    layers = [dict(l) for l in wine.DEFAULTS['layers']]\n"
+            "    for l in layers:\n"
+            "        l['<-'] = {**l['<-'], 'learning_rate': lr}\n"
+            "    root.wine.layers = layers\n"
+            "    load(wine.build_workflow)\n"
+            "    main()\n"
+        )
+        args = make_parser().parse_args(
+            [str(wf_py), "--random-seed", "11", "--stop-after", "2"]
+        )
+
+        def run_once(n_workers):
+            prng.reset()
+            prng.seed_all(11)
+            from znicz_tpu.core.config import root as r
+            from znicz_tpu.genetics import find_tunables
+
+            # reload each run: the previous search's apply_genome left the
+            # best VALUE where the Tune leaf was (that is its contract)
+            module = _load_module(str(wf_py), "wf_concurrent_test_mod")
+            return optimize_workflow(
+                module,
+                Launcher(args),
+                generations=1,
+                tunables=find_tunables(r),
+                n_workers=n_workers,
+                population_size=3,
+            )
+
+        r2 = run_once(2)
+        r1 = run_once(1)
+        assert np.isfinite(r2["best_fitness"])
+        assert r2["best_fitness"] == r1["best_fitness"]
+        assert r2["best_genome"] == r1["best_genome"]
+
+
 class TestOptimizeCLI:
     def test_optimize_flag_end_to_end(self, tmp_path):
         from znicz_tpu.launcher import run_args

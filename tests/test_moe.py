@@ -88,6 +88,80 @@ class TestMoE:
             losses.append(float(val))
         assert losses[-1] < losses[0] * 0.5
 
+    def test_capacity_matches_dense_when_ample(self):
+        # with capacity >= every expert's worst-case load, no token drops
+        # and the two dispatch modes compute identical math
+        params = self._params(e=8, f=8, h=16, seed=3)
+        x = jax.random.normal(jax.random.key(4), (24, 8))
+        dense = moe.apply(params, x, top_k=2, dispatch="dense")
+        cap = moe.apply(
+            params, x, top_k=2, dispatch="capacity", capacity_factor=8.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(cap), np.asarray(dense), rtol=1e-5, atol=1e-6
+        )
+
+    def test_capacity_drops_overflow_tokens(self):
+        # router forces every token onto expert 0; with capacity_factor=1
+        # and E=4, capacity = ceil(B/4) so later tokens get zero output
+        params = self._params(e=4, f=8, h=16, seed=9)
+        p2 = dict(params)
+        router = np.zeros((8, 4), np.float32)
+        router[:, 0] = 0.0  # zero x still ties; use biased inputs instead
+        p2["router"] = jnp.asarray(router)
+        x = jnp.ones((8, 8))
+        out = moe.apply(
+            p2, x, top_k=1, dispatch="capacity", capacity_factor=1.0
+        )
+        # capacity = ceil(1*8/4 * 1.0) = 2: tokens 0-1 served, 2-7 dropped
+        out = np.asarray(out)
+        assert np.abs(out[:2]).max() > 0
+        np.testing.assert_allclose(out[2:], 0.0, atol=1e-7)
+        # identical tokens: the served rows agree with the dense gate value
+        dense = np.asarray(moe.apply(p2, x, top_k=1))
+        np.testing.assert_allclose(out[0], dense[0], rtol=1e-5, atol=1e-6)
+
+    def test_capacity_flops_independent_of_expert_count(self):
+        # the VERDICT gate: for fixed k, compiled FLOPs must not scale
+        # with E under capacity dispatch (dense scales linearly)
+        def flops(e, dispatch):
+            prng.seed_all(11)
+            params = moe.init_params(64, 128, e)
+            x = jnp.ones((256, 64))
+            fn = jax.jit(
+                lambda p, x: moe.apply(
+                    p, x, top_k=2, dispatch=dispatch, capacity_factor=1.0
+                )
+            )
+            analysis = fn.lower(params, x).compile().cost_analysis()
+            if isinstance(analysis, list):  # older jax returns [dict]
+                analysis = analysis[0]
+            return analysis["flops"]
+
+        cap4, cap16 = flops(4, "capacity"), flops(16, "capacity")
+        dense4, dense16 = flops(4, "dense"), flops(16, "dense")
+        assert cap16 < 1.6 * cap4, (cap4, cap16)
+        assert dense16 > 2.5 * dense4, (dense4, dense16)  # the contrast
+
+    def test_expert_parallel_capacity_sharded_matches_replicated(self):
+        # E=16 sharded 4-way on the model axis == replicated (VERDICT #9)
+        mesh = make_mesh(2, 4)
+        params = self._params(e=16, f=8, h=16, seed=13)
+        x = jax.random.normal(jax.random.key(5), (32, 8))
+        ref = moe.apply(
+            params, x, top_k=2, dispatch="capacity", capacity_factor=2.0
+        )
+        sharded = moe.expert_sharding(mesh)(params)
+        assert not sharded["w1"].is_fully_replicated
+        out = jax.jit(
+            lambda p, x: moe.apply(
+                p, x, top_k=2, dispatch="capacity", capacity_factor=2.0
+            )
+        )(sharded, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
     def test_expert_parallel_sharding_matches_replicated(self):
         mesh = make_mesh(2, 4)  # 4-way expert/model axis
         params = self._params(e=4, f=8, h=16, seed=7)

@@ -111,6 +111,31 @@ class TestUnsupervisedDataParallel:
                 ea["train"]["loss"], eb["train"]["loss"], rtol=1e-4
             )
 
+    def test_kohonen_dp_pallas_kernel_matches_xla(self):
+        # the FUSED kernel under data parallel (shard_map + psum rule)
+        # reproduces the XLA composition's single-device training run
+        from znicz_tpu.workflow import KohonenWorkflow
+
+        def build(parallel, impl):
+            prng.seed_all(37)
+            loader = datasets.mnist(
+                n_train=128, n_test=0, minibatch_size=64,
+                normalization="mean_disp",
+            )
+            wf = KohonenWorkflow(
+                loader, sx=4, sy=4, total_epochs=2,
+                parallel=parallel, impl=impl,
+            )
+            wf.initialize(seed=37)
+            return wf.run().history
+
+        a = build(None, "xla")
+        b = build(DataParallel(make_mesh(8, 1)), "pallas")
+        for ea, eb in zip(a, b):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"], rtol=1e-4
+            )
+
     def test_rbm_dp_runs(self):
         from znicz_tpu.workflow import RBMWorkflow
 

@@ -83,6 +83,22 @@ def test_status_writer(tmp_path):
     assert "<table>" in (tmp_path / "status.html").read_text()
 
 
+def test_status_page_embeds_plot_pngs(tmp_path):
+    # watch-while-training: plotters writing into the status dir appear as
+    # auto-refreshed <img> tags (the live-plot story, SURVEY 2.1 graphics)
+    from znicz_tpu.services import AccumulatingPlotter
+
+    prng.seed_all(4)
+    wf = _wf(
+        tmp_path,
+        [AccumulatingPlotter(str(tmp_path), metric="loss"),
+         StatusWriter(str(tmp_path))],
+    )
+    wf.run()
+    page = (tmp_path / "status.html").read_text()
+    assert '<img src="loss.png?t=' in page
+
+
 def test_image_saver(tmp_path):
     prng.seed_all(4)
     wf = _wf(tmp_path, [ImageSaver(str(tmp_path), split="test", n_images=3)])

@@ -2,13 +2,17 @@
 
 Capability parity with ``veles/ensemble/`` [SURVEY.md 2.1 "Ensembles"]: the
 reference trains N instances of a workflow (process-level task parallelism)
-and aggregates their evaluation.  Here instances train sequentially in-process
-(each gets its own derived seed) and predictions aggregate by mean probability
-or majority vote.
+and aggregates their evaluation.  Two modes here: :class:`Ensemble` trains
+in-process sequentially from a ``build_fn`` (each member gets its own
+derived seed), and :func:`train_from_module` trains members CONCURRENTLY in
+spawned worker processes from a workflow-module path (the reference's
+process-level mode) — deterministic given seeds and independent of worker
+count.  Predictions aggregate by mean probability or majority vote.
 """
 
 from __future__ import annotations
 
+from types import SimpleNamespace
 from typing import Callable, List, Optional, Sequence
 
 import jax.numpy as jnp
@@ -129,3 +133,85 @@ class Ensemble(Logger):
                 100.0 * member_errs.mean() / max(n, 1)
             ),
         }
+
+
+def train_from_module(
+    workflow_path: str,
+    *,
+    config_path: Optional[str] = None,
+    n_models: int = 5,
+    base_seed: int = 1234,
+    n_workers: int = 1,
+    stop_after: Optional[int] = None,
+    device: Optional[str] = None,
+) -> Ensemble:
+    """Train ``n_models`` members of a workflow module concurrently in
+    ``n_workers`` spawned processes (the reference's process-level ensemble
+    mode).  Member i trains with seed ``base_seed + 1000*i`` in a fresh
+    interpreter, so the result is deterministic given seeds and identical
+    for every ``n_workers``.  Returns a fitted :class:`Ensemble` whose
+    members share the parent's workflow (model/loader) but carry their own
+    trained params — ``predict``/``evaluate`` work as usual.
+
+    On a single shared accelerator pass ``device="cpu"`` — workers would
+    contend for the one chip.
+    """
+    import pickle
+    import tempfile
+
+    from znicz_tpu.core.subproc import (
+        _run_workflow_module,
+        run_pool,
+        train_member,
+    )
+
+    seeds = [base_seed + 1000 * i for i in range(n_models)]
+    with tempfile.TemporaryDirectory(prefix="znicz_ens_") as tmp:
+        payloads = [
+            {
+                "workflow": workflow_path,
+                "config": config_path,
+                "seed": seed,
+                "stop_after": stop_after,
+                "device": device,
+                "params_path": f"{tmp}/member_{i}.params",
+            }
+            for i, seed in enumerate(seeds)
+        ]
+        results = run_pool(train_member, payloads, n_workers)
+        member_params = []
+        for r in results:
+            with open(r["params_path"], "rb") as f:
+                member_params.append(pickle.load(f))
+    # build the aggregation scaffold in-process (dry run: model + loader,
+    # no training) and graft each member's trained params onto views of it
+    launcher, _ = _run_workflow_module(
+        workflow_path, config_path,
+        seed=base_seed, stop_after=stop_after, device=device, dry_run=True,
+    )
+    wf = launcher.workflow
+
+    def _no_rebuild():
+        raise RuntimeError(
+            "this Ensemble's members were trained out-of-process; "
+            "re-train via ensemble.train_from_module(...), not .train()"
+        )
+
+    ens = Ensemble(_no_rebuild, n_models=n_models, base_seed=base_seed)
+    ens.workflows = [
+        SimpleNamespace(
+            model=wf.model,
+            loader=wf.loader,
+            state=SimpleNamespace(params=params),
+        )
+        for params in member_params
+    ]
+    ens.decisions = [
+        SimpleNamespace(best_value=r["best_value"]) for r in results
+    ]
+    for i, (seed, r) in enumerate(zip(seeds, results)):
+        ens.info(
+            "member %d/%d (seed %d): best=%s", i + 1, n_models, seed,
+            r["best_value"],
+        )
+    return ens

@@ -32,6 +32,7 @@ MAGIC = b"ZNICZT01"
 NATIVE_SUPPORTED_PREFIXES = (
     "all2all", "softmax", "conv", "max_pooling", "avg_pooling",
     "maxabs_pooling", "stochastic_pooling", "norm", "dropout", "activation_",
+    "deconv", "cutter",
 )
 
 # forward-config keys the native engine understands, per layer type

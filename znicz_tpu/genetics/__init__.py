@@ -82,13 +82,22 @@ class GeneticOptimizer(Logger):
         mutation_rate: float = 0.3,
         elite: int = 2,
         rand_name: str = "genetics",
+        evaluate_batch=None,  # genomes: List[List[float]] -> List[float]
     ):
+        """``evaluate_batch``: optional concurrent evaluator for a whole
+        uncached generation (the reference ran its evaluations as parallel
+        workflow instances at process level, SURVEY.md 2.5); falls back to
+        ``evaluate`` per genome when absent.  Results must not depend on
+        completion order — the GA consumes them positionally."""
         if not tunables:
             raise ValueError(
                 "no Tune leaves found in the config tree; mark hyperparams "
                 "with znicz_tpu.genetics.Tune to use --optimize"
             )
+        if evaluate is None and evaluate_batch is None:
+            raise ValueError("need evaluate or evaluate_batch")
         self.evaluate = evaluate
+        self.evaluate_batch = evaluate_batch
         self.tunables = tunables
         self.population_size = population_size
         self.mutation_rate = mutation_rate
@@ -146,6 +155,21 @@ class GeneticOptimizer(Logger):
             return fitness_cache[key]
 
         for g in range(generations):
+            if self.evaluate_batch is not None:
+                # evaluate the whole uncached slice of this generation
+                # concurrently (deduplicated, order-stable)
+                pending = list(
+                    dict.fromkeys(
+                        tuple(genome)
+                        for genome in population
+                        if tuple(genome) not in fitness_cache
+                    )
+                )
+                if pending:
+                    results = self.evaluate_batch(
+                        [list(key) for key in pending]
+                    )
+                    fitness_cache.update(zip(pending, results))
             scored = sorted(
                 (fitness(genome), genome) for genome in population
             )
@@ -173,7 +197,13 @@ class GeneticOptimizer(Logger):
 
 
 def optimize_workflow(
-    module, launcher, *, generations: int, tunables=None, **ga_kwargs
+    module,
+    launcher,
+    *,
+    generations: int,
+    tunables=None,
+    n_workers: int = 0,
+    **ga_kwargs,
 ):
     """Drive ``--optimize``: evolve the Tune leaves of the config tree by
     repeatedly building + training the module's workflow.
@@ -181,6 +211,14 @@ def optimize_workflow(
     ``tunables``: pass a pre-collected ``find_tunables(root)`` result when
     the caller ran anything (e.g. an export probe) that may have
     materialized extra Tune copies into the tree since startup.
+
+    ``n_workers`` >= 1 evaluates each generation in spawned worker
+    processes (the reference's process-level concurrent evaluations,
+    SURVEY.md 2.5) — every evaluation gets a fresh interpreter seeded from
+    ``--random-seed``, so results are deterministic given seeds and
+    IDENTICAL for any worker count.  0 (default) keeps the legacy
+    in-process sequential path.  On a single shared accelerator run the
+    search with ``--device cpu`` — workers would contend for the one chip.
     """
     if tunables is None:
         tunables = find_tunables(root)
@@ -202,7 +240,31 @@ def optimize_workflow(
             return float("inf")
         return float(dec.best_value)
 
-    optimizer = GeneticOptimizer(evaluate, tunables, **ga_kwargs)
+    evaluate_batch = None
+    if n_workers >= 1:
+        from znicz_tpu.core.subproc import eval_genome, run_pool
+
+        args = launcher.args
+
+        def evaluate_batch(genomes):
+            payloads = [
+                {
+                    "workflow": args.workflow,
+                    "config": args.config,
+                    "seed": args.random_seed,
+                    "stop_after": args.stop_after,
+                    "device": args.device,
+                    "genome": genome,
+                }
+                for genome in genomes
+            ]
+            return run_pool(eval_genome, payloads, n_workers)
+
+        evaluate = None  # all evaluations go through the worker pool
+
+    optimizer = GeneticOptimizer(
+        evaluate, tunables, evaluate_batch=evaluate_batch, **ga_kwargs
+    )
     result = optimizer.run(generations)
     optimizer.apply_genome(result["best_genome"])  # leave best config applied
     optimizer.info(

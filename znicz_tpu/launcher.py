@@ -79,6 +79,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="override the workflow's max_epochs")
     p.add_argument("--optimize", type=int, default=None, metavar="GENS",
                    help="genetic hyperparameter search for N generations")
+    p.add_argument("--optimize-workers", type=int, default=0, metavar="N",
+                   help="evaluate each generation in N spawned worker "
+                        "processes (reference: concurrent workflow "
+                        "instances); deterministic given --random-seed and "
+                        "independent of N. Combine with --device cpu on a "
+                        "single shared accelerator")
     p.add_argument("--export", default=None, metavar="MODEL.znicz",
                    help="after training, export the model for the native "
                         "inference engine (native/znicz_infer)")
@@ -259,7 +265,11 @@ def run_args(argv=None) -> Launcher:
             _prng.reset()
             _prng.load_state_dict(prng_state)
         launcher.result = optimize_workflow(
-            module, launcher, generations=args.optimize, tunables=tunables
+            module,
+            launcher,
+            generations=args.optimize,
+            tunables=tunables,
+            n_workers=args.optimize_workers,
         )
         if export_path:
             args.export = export_path

@@ -10,6 +10,16 @@ psum over ICI — the expert-parallel all-to-all collapses into the one
 collective TPUs do best.  For the small expert counts this framework targets
 (4-16), dense dispatch is the right trade (scaling-book style reasoning:
 MXU utilization beats saved FLOPs at these sizes).
+
+``dispatch="capacity"`` is the mode that scales to many experts:
+GShard-style capacity-bounded dispatch.  Each expert processes at most
+``C = ceil(k*B/E * capacity_factor)`` tokens; routing builds one-hot
+dispatch/combine tensors [B, E, C] (dense masks, not scatters —
+TPU-friendly) and the expert matmuls run on the dispatched [E, C, F]
+block, so expert FLOPs are ``k*B*capacity_factor*F*H`` — independent of E.
+Tokens over capacity are dropped (output 0; the residual layer wrapper
+passes them through unchanged — standard token-drop accounting).  Slot
+priority is (choice rank, token index), so results are deterministic.
 """
 
 from __future__ import annotations
@@ -66,14 +76,24 @@ def apply(
     x: jnp.ndarray,  # [B, F]
     *,
     top_k: int = 1,
+    dispatch: str = "dense",
+    capacity_factor: float = 1.25,
 ) -> jnp.ndarray:
     """Gated expert combination; returns [B, F] (residual-style output dim).
 
     Gate: softmax over the top-k router logits per token (renormalized),
-    zero elsewhere.
+    zero elsewhere.  ``dispatch``: "dense" (every expert runs every token;
+    right for E <= ~4) or "capacity" (GShard-style capacity-bounded
+    dispatch; expert FLOPs independent of E — the scaling mode).
     """
     logits = x @ params["router"]  # [B, E]
     e = logits.shape[-1]
+    if dispatch == "capacity" and top_k < e:
+        return _capacity_apply(
+            params, x, logits, top_k=top_k, capacity_factor=capacity_factor
+        )
+    if dispatch not in ("dense", "capacity"):
+        raise ValueError(f"unknown dispatch mode {dispatch!r}")
     if top_k >= e:
         gates = jax.nn.softmax(logits, axis=-1)
     else:
@@ -92,6 +112,47 @@ def apply(
         "ebh,ehf->ebf", h, params["w2"], preferred_element_type=jnp.float32
     ) + params["b2"][:, None, :]
     out = jnp.einsum("be,ebf->bf", gates.astype(y.dtype), y)
+    return out.astype(x.dtype)
+
+
+def expert_capacity(
+    batch: int, n_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    """Per-expert token budget C (static; shapes must be jit-constant)."""
+    return max(1, int(np.ceil(top_k * batch / n_experts * capacity_factor)))
+
+
+def _capacity_apply(params, x, logits, *, top_k, capacity_factor):
+    b, e = logits.shape
+    cap = expert_capacity(b, e, top_k, capacity_factor)
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)  # [B, k]
+    g = jax.nn.softmax(top_vals, axis=-1)  # [B, k]
+    onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [B, k, E]
+    # slot position inside each expert's capacity buffer, priority
+    # (choice rank, token index): flatten slot-major and cumsum per expert
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * b, e)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # [k*B, E]
+    pos = jnp.sum(pos_flat * flat, axis=-1).astype(jnp.int32)  # [k*B]
+    pos = pos.reshape(top_k, b).T  # [B, k] position in its expert
+    keep = (pos < cap).astype(jnp.float32)  # token-drop accounting
+    poshot = jax.nn.one_hot(pos, cap, dtype=jnp.float32) * keep[..., None]
+    dispatch = jnp.einsum("bke,bkc->bec", onehot, poshot)  # [B, E, C]
+    combine = jnp.einsum("bk,bke,bkc->bec", g, onehot, poshot)
+    xe = jnp.einsum(
+        "bec,bf->ecf", dispatch.astype(x.dtype), x,
+        preferred_element_type=jnp.float32,
+    )  # [E, C, F]
+    h = jnp.tanh(
+        jnp.einsum(
+            "ecf,efh->ech", xe, params["w1"],
+            preferred_element_type=jnp.float32,
+        )
+        + params["b1"][:, None, :]
+    )
+    y = jnp.einsum(
+        "ech,ehf->ecf", h, params["w2"], preferred_element_type=jnp.float32
+    ) + params["b2"][:, None, :]
+    out = jnp.einsum("bec,ecf->bf", combine.astype(y.dtype), y)
     return out.astype(x.dtype)
 
 

@@ -50,18 +50,24 @@ def lrn(
 
     ``impl="xla"`` (default): the reduce_window composition — XLA fuses it
     into neighboring conv/elementwise ops and this measured FASTER than the
-    hand kernel inside AlexNet training (11.6k vs 9.0k images/sec on one
-    v5e chip, bench.py), because a pallas_call is a fusion barrier.
-    ``impl="pallas"``: the fused VMEM kernel (znicz_tpu/ops/pallas/lrn.py),
-    kept as the hand-written-kernel path (reference ocl/cuda analog) and for
-    standalone LRN-heavy uses where no surrounding fusion exists.
+    hand kernel inside AlexNet training (12.5k vs 9.5k images/sec on one
+    v5e chip, tuned kernels, r2), because a pallas_call is a fusion
+    barrier.  ``impl="pallas"``: the fused VMEM kernel
+    (znicz_tpu/ops/pallas/lrn.py) — standalone it WINS the train-op pair
+    (fwd+bwd 0.63 ms vs 1.02 ms on [256,27,27,96] v5e: the fused backward
+    recomputes s in VMEM and does both windowed sums as MXU band matmuls,
+    where XLA's reduce_window transpose is memory-bound); forward-only XLA
+    stays ahead (0.43 vs 0.57 ms).  Numbers: tests/test_pallas.py TPU
+    timing assertions.
     """
     if impl == "pallas":
         from znicz_tpu.ops.pallas import lrn as pallas_lrn
 
         return pallas_lrn.lrn(x, alpha, beta, k, n)
+    from znicz_tpu.ops.pallas.lrn import _inv_pow
+
     sums = _window_sums(jnp.square(x), n)
-    return x * jnp.power(k + alpha * sums, -beta)
+    return x * _inv_pow(k + alpha * sums, beta)
 
 
 def layer_norm(

@@ -4,7 +4,14 @@ Parity with ``veles/web_status.py`` [SURVEY.md 2.1 "Web status"]: the
 reference runs a tornado dashboard showing master/slaves/workflow progress.
 Here the per-epoch state is written as ``status.json`` + a static
 ``status.html`` that auto-refreshes — servable by anything (``python -m
-http.server``), with no long-running service process coupled to training.
+znicz_tpu.services.serve``), with no long-running service process coupled
+to training.
+
+Watch-while-training (the reference's live ZMQ plot rendering,
+``veles/graphics_server.py``): point the plotters
+(:mod:`znicz_tpu.services.plotting`) at the SAME directory and the status
+page embeds every ``*.png`` it finds, cache-busted per refresh — error
+curves and Weights2D tiles update live in the browser as epochs finish.
 """
 
 from __future__ import annotations
@@ -57,6 +64,21 @@ class StatusWriter:
         except Exception:  # status must never break training
             return []
 
+    def _plot_images(self) -> list:
+        """PNGs in the status directory (plotters writing alongside) with
+        mtime cache-busters so the auto-refresh shows the newest frame."""
+        out = []
+        try:
+            for name in sorted(os.listdir(self.directory)):
+                if name.endswith(".png"):
+                    mtime = int(
+                        os.path.getmtime(os.path.join(self.directory, name))
+                    )
+                    out.append((name, mtime))
+        except OSError:  # status must never break training
+            pass
+        return out
+
     def _write_html(self, status) -> None:
         rows = []
         for split, m in status["summary"].items():
@@ -81,6 +103,11 @@ best {status['best_value']} @ {status['best_epoch']} —
 <p>devices: {html.escape(', '.join(status['devices']))}</p>
 <table><tr><th>split</th><th>n</th><th>loss</th><th>err%</th></tr>
 {''.join(rows)}</table>
+{''.join(
+    f'<p><img src="{html.escape(name)}?t={mtime}" '
+    f'alt="{html.escape(name)}" style="max-width:45em"></p>'
+    for name, mtime in self._plot_images()
+)}
 </body></html>"""
         with open(os.path.join(self.directory, "status.html"), "w") as f:
             f.write(doc)

@@ -225,7 +225,9 @@ def build(
         elif kind == "norm":
             p = {}
             kwargs = {
-                k: fwd[k] for k in ("alpha", "beta", "k", "n") if k in fwd
+                k: fwd[k]
+                for k in ("alpha", "beta", "k", "n", "impl")
+                if k in fwd
             }
 
             def fn(p, x, train, rng, kw=kwargs):
@@ -265,20 +267,31 @@ def build(
             n_hidden = int(fwd.get("n_hidden", 4 * d))
             top_k = int(fwd.get("top_k", 1))
             residual = bool(fwd.get("residual", True))
+            # dense dispatch through E=16 (exact math, MXU-friendly — see
+            # ops/moe.py), capacity-bounded token-drop dispatch above;
+            # "dispatch" overrides either way
+            dispatch = fwd.get(
+                "dispatch", "dense" if n_experts <= 16 else "capacity"
+            )
+            cap_factor = float(fwd.get("capacity_factor", 1.25))
             p = moe_op.init_params(
                 d, n_hidden, n_experts,
                 rand_name=rand_name, **_init_kwargs_moe(fwd),
             )
 
-            def fn(p, x, train, rng, k=top_k, res=residual):
+            def fn(p, x, train, rng, k=top_k, res=residual,
+                   disp=dispatch, cf=cap_factor):
                 if x.ndim == 3:  # per-token on sequences
                     b, t, dd = x.shape
                     y = moe_op.apply(
-                        p, x.reshape(b * t, dd), top_k=k
+                        p, x.reshape(b * t, dd), top_k=k,
+                        dispatch=disp, capacity_factor=cf,
                     ).reshape(b, t, dd)
                     return x + y if res else y
                 flat = x.reshape(x.shape[0], -1)
-                y = moe_op.apply(p, flat, top_k=k)
+                y = moe_op.apply(
+                    p, flat, top_k=k, dispatch=disp, capacity_factor=cf
+                )
                 return flat + y if res else y
 
             if len(shape) != 3:  # flattened-token path emits [B, d]

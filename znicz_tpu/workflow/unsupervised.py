@@ -83,14 +83,16 @@ class KohonenWorkflow(Workflow):
         coords = kh.grid_coords(self.sx, self.sy)
         n_steps_per_epoch = max(self.loader.n_minibatches(TRAIN), 1)
         total_steps = self.total_epochs * n_steps_per_epoch
-        # the fused kernel has no partitioning rule: under a sharded batch
-        # (data parallel) the XLA composition is the correct path
-        use_pallas = self.parallel is None and (
-            self.impl == "pallas"
-            or (
-                self.impl == "auto"
-                and jax.default_backend() in ("tpu", "axon")
-            )
+        # fused kernel partitioning rule: under a sharded batch the kernel
+        # accumulates local (num, den) partials inside shard_map and psums
+        # them over the data axis — the fast path survives data parallelism
+        use_pallas = self.impl == "pallas" or (
+            self.impl == "auto" and jax.default_backend() in ("tpu", "axon")
+        )
+        pallas_mesh = (
+            self.parallel.mesh
+            if use_pallas and self.parallel is not None
+            else None
         )
         if use_pallas:
             from znicz_tpu.ops.pallas import kohonen as pallas_kh
@@ -115,6 +117,7 @@ class KohonenWorkflow(Workflow):
                     learning_rate=lr * lr_scale,
                     sigma=sigma,
                     mask=mask,
+                    mesh=pallas_mesh,
                 )
             else:
                 params, win = kh.train_step(
@@ -178,6 +181,7 @@ class RBMWorkflow(Workflow):
         parallel=None,
         prefetch_batches: int = 2,
         rand_name: str = "default",
+        impl: str = "auto",  # "pallas" | "xla" | "auto" (pallas on TPU)
         name: str = "RBMWorkflow",
     ):
         super().__init__(
@@ -195,23 +199,55 @@ class RBMWorkflow(Workflow):
         self.learning_rate = learning_rate
         self.cd_k = cd_k
         self.rand_name = rand_name
+        self.impl = impl
         self._n_visible = int(jnp.prod(jnp.asarray(loader.sample_shape)))
 
     def _batch_target(self, mb):
         return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
 
     def _build_steps(self):
+        from znicz_tpu.ops.pallas import rbm as pallas_rbm
+
+        # fused CD-k kernel (hardware RNG, whole Gibbs chain in VMEM) when
+        # on TPU and the problem fits the VMEM budget; the psum rule keeps
+        # it available under a sharded batch (see ops/pallas/rbm.py)
+        use_pallas = self.impl == "pallas" or (
+            self.impl == "auto"
+            and jax.default_backend() in ("tpu", "axon")
+            and pallas_rbm.fits_vmem(
+                self.loader.max_minibatch_size,
+                self._n_visible,
+                self.n_hidden,
+            )
+        )
+        pallas_mesh = (
+            self.parallel.mesh
+            if use_pallas and self.parallel is not None
+            else None
+        )
+
         def train_step(state: TrainState, x, y, mask, lr_scale):
             v0 = x.reshape(x.shape[0], -1)
-            rng = jax.random.fold_in(state.key, state.step)
-            params, err = rbm_op.cd_step(
-                state.params,
-                v0,
-                rng,
-                learning_rate=self.learning_rate * lr_scale,
-                cd_k=self.cd_k,
-                mask=mask,
-            )
+            if use_pallas:
+                params, err = pallas_rbm.cd_step(
+                    state.params,
+                    v0,
+                    state.step,
+                    learning_rate=self.learning_rate * lr_scale,
+                    cd_k=self.cd_k,
+                    mask=mask,
+                    mesh=pallas_mesh,
+                )
+            else:
+                rng = jax.random.fold_in(state.key, state.step)
+                params, err = rbm_op.cd_step(
+                    state.params,
+                    v0,
+                    rng,
+                    learning_rate=self.learning_rate * lr_scale,
+                    cd_k=self.cd_k,
+                    mask=mask,
+                )
             metrics = {
                 "loss": err,
                 "n_samples": jnp.maximum(jnp.sum(mask), 1.0),
