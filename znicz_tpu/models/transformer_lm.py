@@ -27,6 +27,10 @@ DEFAULTS = {
     "n_layers": 2,
     "n_heads": 4,
     "max_epochs": 15,
+    # >1: pipeline the block tower over that many devices (config-file
+    # route to pipeline parallelism; n_layers must divide by it)
+    "pipeline_stages": 0,
+    "pipeline_microbatches": 0,
 }
 root.transformer_lm.update(DEFAULTS)
 
@@ -63,17 +67,31 @@ def build_workflow(**overrides) -> TransformerLMWorkflow:
         {"train": train, "test": test},
         minibatch_size=lcfg.get("minibatch_size", 64),
     )
-    kwargs = merge_workflow_kwargs(
-        {
-            "vocab": vocab,
-            "d_model": cfg.get("d_model", 64),
-            "n_layers": cfg.get("n_layers", 2),
-            "n_heads": cfg.get("n_heads", 4),
-            "max_epochs": cfg.get("max_epochs", 15),
-            "name": "TransformerLMWorkflow",
-        },
-        overrides,
-    )
+    defaults = {
+        "vocab": vocab,
+        "d_model": cfg.get("d_model", 64),
+        "n_layers": cfg.get("n_layers", 2),
+        "n_heads": cfg.get("n_heads", 4),
+        "max_epochs": cfg.get("max_epochs", 15),
+        "name": "TransformerLMWorkflow",
+    }
+    pp_stages = int(cfg.get("pipeline_stages", 0) or 0)
+    if pp_stages > 1:
+        from znicz_tpu.parallel import make_mesh
+
+        defaults.update(
+            {
+                "pipeline_parallel": True,
+                # make_mesh validates the device count — a host with fewer
+                # devices errors instead of silently degrading the stage
+                # count the config asked for
+                "mesh": make_mesh(1, 1, pp_stages),
+                "pipeline_microbatches": (
+                    int(cfg.get("pipeline_microbatches", 0) or 0) or None
+                ),
+            }
+        )
+    kwargs = merge_workflow_kwargs(defaults, overrides)
     from znicz_tpu.models import translate_unsupervised_overrides
 
     kwargs = translate_unsupervised_overrides(kwargs, "max_epochs")

@@ -118,6 +118,28 @@ def update(params, grads, velocity, hyper):
     return type(params)(out_p), type(velocity)(out_v)
 
 
+def _leaf_name(path) -> str:
+    for entry in reversed(path):
+        if isinstance(entry, jax.tree_util.DictKey):
+            return str(entry.key)
+    return ""
+
+
+def update_pytree(params, grads, velocity, hyper: HyperParams):
+    """Name-aware update over an ARBITRARY pytree (bias rules keyed on the
+    innermost dict key, like update_layer) — for models whose params are
+    not a flat list of layer dicts, e.g. the pipelined transformer's
+    stacked stage groups."""
+    pairs = jax.tree_util.tree_map_with_path(
+        lambda p, w, g, v: update_param(w, g, v, _leaf_name(p), hyper),
+        params, grads, velocity,
+    )
+    is_pair = lambda t: isinstance(t, tuple)  # noqa: E731
+    new_p = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
+    new_v = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+    return new_p, new_v
+
+
 def clip_gradients(grads, max_norm: Optional[float]):
     """Global-norm gradient clipping (upgrade knob; reference clips per-unit
     via ``gradient_*_with_clip`` variants [low confidence], exposed here as a

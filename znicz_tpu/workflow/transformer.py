@@ -81,22 +81,81 @@ def init_lm_params(
     return params
 
 
+def _embed_tokens(embed, tokens):
+    t = tokens.shape[1]
+    return embed["embed"][tokens] + embed["pos"][:t][None, :, :]
+
+
+def _block_forward(block, x, *, n_heads, attention_fn=None):
+    """One pre-LN transformer block (the ONLY definition — lm_apply and the
+    pipelined stage_fn both call it, so they cannot drift apart)."""
+    attention_fn = attention_fn or attention.dot_product_attention
+    h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
+    x = x + attention.mha(
+        block, h, n_heads=n_heads, causal=True, attention_fn=attention_fn
+    )
+    h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
+    h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
+    return x + h @ block["w_down"] + block["down_bias"]
+
+
 def lm_apply(params, tokens, *, n_heads, attention_fn=None):
     """tokens [B, T] int32 -> logits [B, T, vocab]."""
     attention_fn = attention_fn or attention.dot_product_attention
-    embed = params[0]
-    t = tokens.shape[1]
-    x = embed["embed"][tokens] + embed["pos"][:t][None, :, :]
+    x = _embed_tokens(params[0], tokens)
     for block in params[1:-1]:
-        h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
-        x = x + attention.mha(
-            block, h, n_heads=n_heads, causal=True,
-            attention_fn=attention_fn,
+        x = _block_forward(
+            block, x, n_heads=n_heads, attention_fn=attention_fn
         )
-        h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
-        h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
-        x = x + h @ block["w_down"] + block["down_bias"]
     return x @ params[-1]["head"]
+
+
+def stack_lm_blocks(params, n_stages: int):
+    """[embed, block_0..L-1, head] -> {"embed", "stages", "head"} with the
+    blocks grouped into ``n_stages`` equal stage-groups and stacked on a
+    leading stage dim (the :mod:`znicz_tpu.parallel.pipeline` layout).
+    Initialization draw order is untouched — the restructure happens after
+    ``init_lm_params``."""
+    from znicz_tpu.parallel.pipeline import stack_stage_params
+
+    blocks = params[1:-1]
+    if len(blocks) % n_stages:
+        raise ValueError(
+            f"n_layers={len(blocks)} not divisible by pipeline stages "
+            f"{n_stages}"
+        )
+    g = len(blocks) // n_stages
+    groups = [blocks[s * g:(s + 1) * g] for s in range(n_stages)]
+    return {
+        "embed": params[0],
+        "stages": stack_stage_params(groups),
+        "head": params[-1],
+    }
+
+
+def lm_apply_pipelined(
+    params_pp, tokens, *, n_heads, mesh, n_microbatches
+):
+    """tokens [B, T] -> logits, with the block tower pipelined over the
+    mesh's ``pipe`` axis (embed/head run outside the shard_map)."""
+    from znicz_tpu.parallel.pipeline import pipelined_model_apply
+
+    def embed_fn(p, tok):
+        return _embed_tokens(p, tok)
+
+    def stage_fn(blocks, x):
+        for block in blocks:  # this stage's group of transformer blocks
+            x = _block_forward(block, x, n_heads=n_heads)
+        return x
+
+    def head_fn(p, x):
+        return x @ p["head"]
+
+    return pipelined_model_apply(
+        params_pp, tokens,
+        embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
+        mesh=mesh, n_microbatches=n_microbatches,
+    )
 
 
 def lm_tp_rules(path: str, leaf):
@@ -134,6 +193,12 @@ class TransformerLMWorkflow(Workflow):
     mesh's ``model`` axis (``lm_tp_rules``); composes with DP and SP on the
     same mesh.  Requires ``parallel=DataParallel(mesh)`` with a model axis
     > 1 and n_heads divisible by it.
+    ``pipeline_parallel``: pipeline the block tower over the mesh's
+    ``pipe`` axis (GPipe microbatching, ``parallel/pipeline.py``); pass a
+    ``mesh`` with a pipe axis whose size divides ``n_layers``.  Stage
+    params live chunk-per-device; embed/head run outside the pipeline.
+    Mutually exclusive with sequence/tensor parallel (one mesh axis per
+    workflow for now).
     """
 
     def __init__(
@@ -148,6 +213,8 @@ class TransformerLMWorkflow(Workflow):
         hyper: Optional[optimizer.HyperParams] = None,
         sequence_parallel: bool = False,
         tensor_parallel: bool = False,
+        pipeline_parallel: bool = False,
+        pipeline_microbatches: Optional[int] = None,
         mesh=None,
         decision: Optional[Decision] = None,
         snapshotter: Optional[Snapshotter] = None,
@@ -183,8 +250,36 @@ class TransformerLMWorkflow(Workflow):
         self.rand_name = rand_name
         self.sequence_parallel = sequence_parallel
         self.tensor_parallel = tensor_parallel
+        self.pipeline_parallel = pipeline_parallel
         self.mesh = mesh
         self.max_seq = int(loader.sample_shape[0])
+        if pipeline_parallel:
+            from znicz_tpu.parallel.mesh import PIPE_AXIS
+
+            if sequence_parallel or tensor_parallel:
+                raise ValueError(
+                    "pipeline_parallel is mutually exclusive with "
+                    "sequence/tensor parallel (one mesh axis per workflow)"
+                )
+            if parallel is not None:
+                raise ValueError(
+                    "pipeline_parallel=True cannot combine with "
+                    "parallel=DataParallel(...): the batch placement would "
+                    "ride a different mesh than the pipe shard_map"
+                )
+            if mesh is None or PIPE_AXIS not in mesh.shape:
+                raise ValueError(
+                    "pipeline_parallel=True needs mesh= with a 'pipe' axis"
+                )
+            self._n_stages = mesh.shape[PIPE_AXIS]
+            if n_layers % self._n_stages:
+                raise ValueError(
+                    f"n_layers={n_layers} not divisible by pipe axis "
+                    f"{self._n_stages}"
+                )
+            self.pipeline_microbatches = (
+                pipeline_microbatches or self._n_stages
+            )
         if tensor_parallel:
             from znicz_tpu.parallel import DataParallel
 
@@ -227,11 +322,21 @@ class TransformerLMWorkflow(Workflow):
         n_heads = self.n_heads
         attention_fn = self._attention_fn()
 
+        if self.pipeline_parallel:
+            apply_fn = partial(
+                lm_apply_pipelined,
+                n_heads=n_heads,
+                mesh=self.mesh,
+                n_microbatches=self.pipeline_microbatches,
+            )
+        else:
+            apply_fn = partial(
+                lm_apply, n_heads=n_heads, attention_fn=attention_fn
+            )
+
         def loss_metrics(params, tokens, mask):
             tokens = tokens.astype(jnp.int32)
-            logits = lm_apply(
-                params, tokens, n_heads=n_heads, attention_fn=attention_fn
-            )
+            logits = apply_fn(params, tokens)
             # next-token CE: predict tokens[:, 1:] from positions [:-1]
             logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
             tgt = tokens[:, 1:]
@@ -262,9 +367,14 @@ class TransformerLMWorkflow(Workflow):
                     else self.hyper.learning_rate_bias * lr_scale
                 ),
             )
-            new_p, new_v = optimizer.update(
-                state.params, grads, state.velocity, hyper
-            )
+            if self.pipeline_parallel:  # dict-of-stacked-stages pytree
+                new_p, new_v = optimizer.update_pytree(
+                    state.params, grads, state.velocity, hyper
+                )
+            else:
+                new_p, new_v = optimizer.update(
+                    state.params, grads, state.velocity, hyper
+                )
             return (
                 state._replace(
                     params=new_p, velocity=new_v, step=state.step + 1
@@ -291,4 +401,13 @@ class TransformerLMWorkflow(Workflow):
             self.max_seq,
             rand_name=self.rand_name,
         )
+        if self.pipeline_parallel:
+            from znicz_tpu.parallel.pipeline import shard_stacked_params
+
+            params = stack_lm_blocks(params, self._n_stages)
+            # stage params chunk-per-device up front; embed/head stay
+            # replicated (GSPMD propagates through the update)
+            params["stages"] = shard_stacked_params(
+                params["stages"], self.mesh
+            )
         return TrainState.create(params, prng.get("workflow").key())
