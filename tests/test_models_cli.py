@@ -122,6 +122,45 @@ class TestModelZoo:
         dec = wf.run()
         assert dec.history[-1]["train"]["loss"] < dec.history[0]["train"]["loss"]
 
+    def test_image_dir_models_train_on_real_files(self, tmp_path):
+        # kanji / yale_faces / video_ae accept a data_dir of real images
+        # (reference image-dir pipelines) instead of the synthetic stand-in
+        import matplotlib
+
+        matplotlib.use("Agg", force=True)
+        import matplotlib.image as mpimg
+
+        gen = np.random.default_rng(3)
+        for split, n in (("train", 6), ("test", 2)):
+            for cls in ("a", "b", "c"):
+                d = tmp_path / split / cls
+                d.mkdir(parents=True)
+                for i in range(n):
+                    img = gen.random((12, 12)).astype(np.float32)
+                    mpimg.imsave(
+                        str(d / f"{i}.png"), img, cmap="gray"
+                    )
+        for module, cfg_node, extra in (
+            ("kanji", root.kanji, {"side": 12, "minibatch_size": 9}),
+            ("yale_faces", root.yale_faces,
+             {"side": 12, "minibatch_size": 9}),
+            ("video_ae", root.video_ae,
+             {"side": 12, "minibatch_size": 9}),
+        ):
+            prng.seed_all(1234)
+            mod = _fresh(module)
+            cfg_node.loader.update({"data_dir": str(tmp_path), **extra})
+            wf = mod.build_workflow(decision_config={"max_epochs": 2})
+            from znicz_tpu.loader.image import ImageDirectoryLoader
+
+            assert isinstance(wf.loader, ImageDirectoryLoader), module
+            wf.initialize(seed=1234)
+            dec = wf.run()
+            assert np.isfinite(dec.history[-1]["train"]["loss"]), module
+            if module != "video_ae":
+                # classifier heads follow the directory's class count
+                assert wf.model.output_shape == (3,), module
+
     def test_alexnet_builds(self):
         # full run is the bench's job; here: builds + one forward shape check
         prng.seed_all(1234)

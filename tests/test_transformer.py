@@ -89,6 +89,30 @@ class TestTransformerWorkflow:
         wf2.initialize(snapshot=str(best))
         assert int(wf2.state.step) > 0
 
+    def test_flash_attention_matches_dot(self):
+        # the blockwise kernel as the workflow's attention: same training
+        # trajectory as the jnp twin
+        tokens = np.asarray(
+            np.random.default_rng(7).integers(0, 16, (16, 24)), np.int32
+        )
+
+        def build_and_run(attention):
+            prng.seed_all(12)
+            ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=16)
+            wf = TransformerLMWorkflow(
+                ld, vocab=16, d_model=32, n_layers=1, n_heads=2,
+                max_epochs=2, attention=attention,
+            )
+            wf.initialize(seed=12)
+            return wf.run().history
+
+        a = build_and_run("dot")
+        b = build_and_run("flash")
+        for ea, eb in zip(a, b):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"], rtol=1e-4
+            )
+
     def test_pipeline_parallel_matches_single_device(self):
         # block tower pipelined over a 4-stage pipe mesh == plain run
         import jax
@@ -125,6 +149,36 @@ class TestTransformerWorkflow:
                 ea["train"]["token_accuracy"],
                 eb["train"]["token_accuracy"],
                 rtol=1e-4,
+            )
+
+    def test_pipeline_with_flash_attention(self):
+        # the chosen attention kernel must survive into the pipelined
+        # stages (it is passed through stage_fn, not silently dropped)
+        import jax
+        from jax.sharding import Mesh
+
+        tokens = np.asarray(
+            np.random.default_rng(9).integers(0, 16, (8, 16)), np.int32
+        )
+        pipe_mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+
+        def run_with(attention):
+            prng.seed_all(14)
+            ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=8)
+            wf = TransformerLMWorkflow(
+                ld, vocab=16, d_model=32, n_layers=2, n_heads=2,
+                max_epochs=2, attention=attention,
+                pipeline_parallel=True, pipeline_microbatches=2,
+                mesh=pipe_mesh,
+            )
+            wf.initialize(seed=14)
+            return wf.run().history
+
+        a = run_with("dot")
+        b = run_with("flash")
+        for ea, eb in zip(a, b):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"], rtol=1e-4
             )
 
     def test_pipeline_via_config_tree(self):

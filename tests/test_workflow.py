@@ -23,6 +23,65 @@ MLP_LAYERS = [
 ]
 
 
+class TestEpochScanDispatch:
+    def test_scan_matches_stepwise(self):
+        # ONE lax.scan dispatch per split (device-resident loaders) must
+        # reproduce the per-batch dispatch path exactly
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+        gen = np.random.default_rng(0)
+        images = gen.integers(0, 256, (96, 8, 8, 1), dtype=np.uint8)
+        labels = (images.mean(axis=(1, 2, 3)) > 127).astype(np.int32)
+
+        def build_wf(dispatch):
+            prng.seed_all(21)
+            loader = FullBatchLoader(
+                {"train": images, "test": images[:32]},
+                {"train": labels, "test": labels[:32]},
+                minibatch_size=32,
+                normalization="range",
+                normalization_kwargs={"scale": 255.0, "shift": -0.5},
+                device_resident=True,
+            )
+            wf = StandardWorkflow(
+                loader,
+                [
+                    {"type": "all2all_tanh",
+                     "->": {"output_sample_shape": 8}},
+                    {"type": "softmax", "->": {"output_sample_shape": 2}},
+                ],
+                decision_config={"max_epochs": 3},
+                default_hyper={"learning_rate": 0.1,
+                               "gradient_moment": 0.9},
+                epoch_dispatch=dispatch,
+            )
+            wf.initialize(seed=21)
+            return wf
+
+        # build AND run each workflow under a freshly seeded registry —
+        # the loader shuffle stream is global, so interleaving two runs
+        # would hand them different permutations
+        wf_scan = build_wf("auto")
+        assert wf_scan._use_epoch_scan()  # device-resident -> scan path
+        a = wf_scan.run().history
+        wf_step = build_wf("step")
+        assert not wf_step._use_epoch_scan()
+        b = wf_step.run().history
+        for ea, eb in zip(a, b):
+            for split in ea:
+                np.testing.assert_allclose(
+                    ea[split]["loss"], eb[split]["loss"],
+                    rtol=1e-5, atol=1e-7,
+                )
+                assert ea[split]["n_err"] == eb[split]["n_err"]
+        # params identical too (same math, same order)
+        np.testing.assert_allclose(
+            np.asarray(wf_scan.state.params[0]["weights"]),
+            np.asarray(wf_step.state.params[0]["weights"]),
+            rtol=1e-6, atol=1e-7,
+        )
+
+
 class TestModelBuilder:
     def test_mlp_shapes(self):
         m = build(MLP_LAYERS, (784,))

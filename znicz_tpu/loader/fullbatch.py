@@ -86,6 +86,10 @@ class FullBatchLoader(Loader):
         # The TPU-first mode for datasets that fit on-chip — per-step input
         # transfer drops from O(batch x sample) to O(batch) bytes.
         self._device_resident = device_resident
+        # per-batch host payloads are bare index vectors: stacking a whole
+        # epoch of them is bytes, so the workflow may compile each split as
+        # ONE lax.scan dispatch (Workflow._use_epoch_scan)
+        self.epoch_scan_friendly = device_resident
         self._pool_offsets: Dict[str, int] = {}
         if device_resident:
             offset = 0

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from znicz_tpu.loader import normalizers
 from znicz_tpu.loader.base import SPLITS, Loader, Minibatch
 
 IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp")
@@ -56,6 +57,10 @@ class ImageDirectoryLoader(Loader):
 
     ``target_shape``: (H, W) or (H, W, C); channels inferred from the first
     image when omitted.  ``grayscale``: average channels to 1.
+    ``normalization``: loader normalizer kind ("none", "mean_disp",
+    "linear", "range"); dataset statistics are fitted once at construction
+    on up to ``normalization_fit_samples`` training images (the loader is
+    lazy — a full pass would defeat streaming) and applied per minibatch.
     """
 
     def __init__(
@@ -64,6 +69,9 @@ class ImageDirectoryLoader(Loader):
         *,
         target_shape: Optional[Tuple[int, ...]] = None,
         grayscale: bool = False,
+        normalization: str = "none",
+        normalization_kwargs: Optional[dict] = None,
+        normalization_fit_samples: int = 512,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -108,6 +116,28 @@ class ImageDirectoryLoader(Loader):
                 f"grayscale=True conflicts with target_shape {target_shape}"
             )
         self.target_shape = tuple(int(s) for s in target_shape)
+        if normalization in ("none", "range"):
+            self.normalizer = normalizers.fit(
+                normalization, np.zeros(0), **(normalization_kwargs or {})
+            )
+        else:
+            # fit dataset statistics on a deterministic sample of the
+            # training split (first N in sorted order — no PRNG draw, so
+            # the reproducibility stream stays untouched)
+            split = "train" if "train" in self.index else next(
+                iter(self.index)
+            )
+            entries = self.index[split][:normalization_fit_samples]
+            h, w, c = self.target_shape
+            sample = np.stack(
+                [
+                    self._load_one(path, h, w, c)
+                    for path, _ in entries
+                ]
+            ).reshape(len(entries), -1)
+            self.normalizer = normalizers.fit(
+                normalization, sample, **(normalization_kwargs or {})
+            )
 
     @property
     def class_lengths(self) -> Dict[str, int]:
@@ -121,6 +151,18 @@ class ImageDirectoryLoader(Loader):
         # enables balanced=True minibatch serving (Loader.reshuffle)
         return np.asarray([label for _, label in self.index[split]], np.int32)
 
+    @staticmethod
+    def _load_one(path: str, h: int, w: int, c: int) -> np.ndarray:
+        img = _resize_nearest(_read_image(path), h, w)
+        if img.shape[-1] != c:
+            if c == 1:  # color source, gray target: average (not slice)
+                img = img.mean(axis=-1, keepdims=True)
+            elif img.shape[-1] == 1:  # gray source, color target
+                img = np.repeat(img, c, axis=-1)
+            else:
+                img = img[:, :, :c]
+        return img
+
     def fill(self, indices: np.ndarray, split: str) -> Minibatch:
         h, w, c = self.target_shape
         data = np.zeros((len(indices), h, w, c), np.float32)
@@ -128,16 +170,11 @@ class ImageDirectoryLoader(Loader):
         entries = self.index[split]
         for row, idx in enumerate(indices):
             path, label = entries[int(idx)]
-            img = _resize_nearest(_read_image(path), h, w)
-            if img.shape[-1] != c:
-                if c == 1:  # color source, gray target: average (not slice)
-                    img = img.mean(axis=-1, keepdims=True)
-                elif img.shape[-1] == 1:  # gray source, color target
-                    img = np.repeat(img, c, axis=-1)
-                else:
-                    img = img[:, :, :c]
-            data[row] = img
+            data[row] = self._load_one(path, h, w, c)
             labels[row] = label
+        data = normalizers.apply(
+            self.normalizer, data.reshape(len(indices), -1)
+        ).reshape(data.shape)
         return Minibatch(
             data=data, labels=labels, targets=None, mask=None, indices=indices
         )

@@ -8,6 +8,29 @@ override ``root`` between import and run.  Every module also exposes
 """
 
 
+def grayscale_image_dir_loader(
+    data_dir: str,
+    *,
+    side: int,
+    minibatch_size: int,
+    normalization: str = "mean_disp",
+):
+    """The zoo's shared real-data path for image-tree datasets
+    (Kanji/YaleFaces/VideoAE): ``train/<class>/*.png`` at side x side,
+    grayscale, with the reference's mean-dispersion normalization fitted
+    on the training images.  One definition so the data_dir conventions
+    cannot drift between models."""
+    from znicz_tpu.loader.image import ImageDirectoryLoader
+
+    return ImageDirectoryLoader(
+        data_dir,
+        target_shape=(side, side, 1),
+        grayscale=True,
+        minibatch_size=minibatch_size,
+        normalization=normalization,
+    )
+
+
 def effective_config(node, defaults: dict):
     """DEFAULTS merged under the user's ``root`` overrides.
 

@@ -15,6 +15,7 @@ _GD = {"learning_rate": 0.02, "gradient_moment": 0.9, "weights_decay": 0.0005}
 
 DEFAULTS = {
     "loader": {
+        "data_dir": None,  # train/<kanji>/*.png tree; synthetic when None
         "minibatch_size": 50,
         "n_train": 1500,
         "n_test": 300,
@@ -36,15 +37,26 @@ def build_workflow(**overrides) -> StandardWorkflow:
     lcfg = cfg.loader
     side = lcfg.get("side", 24)
     n_classes = lcfg.get("n_classes", 24)
-    data, labels = datasets._synthetic_split(
-        lcfg.get("n_train", 1500), lcfg.get("n_test", 300),
-        (side * side,), n_classes,
-    )
-    from znicz_tpu.loader import FullBatchLoader
+    data_dir = lcfg.get("data_dir") or root.common.get("data_dir")
+    if data_dir:
+        # real rendered-glyph images: train/<kanji>/*.png, grayscale
+        from znicz_tpu.models import grayscale_image_dir_loader
 
-    loader = FullBatchLoader(
-        data, labels, minibatch_size=lcfg.get("minibatch_size", 50)
-    )
+        loader = grayscale_image_dir_loader(
+            data_dir, side=side,
+            minibatch_size=lcfg.get("minibatch_size", 50),
+        )
+        n_classes = len(loader.classes)
+    else:
+        data, labels = datasets._synthetic_split(
+            lcfg.get("n_train", 1500), lcfg.get("n_test", 300),
+            (side * side,), n_classes,
+        )
+        from znicz_tpu.loader import FullBatchLoader
+
+        loader = FullBatchLoader(
+            data, labels, minibatch_size=lcfg.get("minibatch_size", 50)
+        )
     layers = cfg.get("layers")
     layers[-1]["->"]["output_sample_shape"] = n_classes
     kwargs = merge_workflow_kwargs(

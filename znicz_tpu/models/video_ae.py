@@ -18,6 +18,8 @@ _GD = {"learning_rate": 0.05, "gradient_moment": 0.9}
 
 DEFAULTS = {
     "loader": {
+        # train/<seq>/*.png frame tree (labels unused); synthetic when None
+        "data_dir": None,
         "minibatch_size": 50,
         "n_sequences": 20,
         "frames_per_seq": 30,
@@ -50,15 +52,27 @@ def build_workflow(**overrides) -> StandardWorkflow:
     cfg = effective_config(root.video_ae, DEFAULTS)
     lcfg = cfg.loader
     side = lcfg.get("side", 16)
-    frames = _synthetic_frames(
-        lcfg.get("n_sequences", 20), lcfg.get("frames_per_seq", 30), side
-    )
-    n_test = len(frames) // 5
-    loader = FullBatchLoader(
-        {"train": frames[n_test:], "test": frames[:n_test]},
-        minibatch_size=lcfg.get("minibatch_size", 50),
-        normalization="mean_disp",
-    )
+    data_dir = lcfg.get("data_dir") or root.common.get("data_dir")
+    if data_dir:
+        # real frames: train/<sequence>/*.png, grayscale at side x side;
+        # directory labels exist but the AE target is the input itself
+        from znicz_tpu.models import grayscale_image_dir_loader
+
+        loader = grayscale_image_dir_loader(
+            data_dir, side=side,
+            minibatch_size=lcfg.get("minibatch_size", 50),
+        )
+    else:
+        frames = _synthetic_frames(
+            lcfg.get("n_sequences", 20), lcfg.get("frames_per_seq", 30),
+            side,
+        )
+        n_test = len(frames) // 5
+        loader = FullBatchLoader(
+            {"train": frames[n_test:], "test": frames[:n_test]},
+            minibatch_size=lcfg.get("minibatch_size", 50),
+            normalization="mean_disp",
+        )
     layers = cfg.get("layers")
     layers[-1]["->"]["output_sample_shape"] = side * side
     kwargs = merge_workflow_kwargs(

@@ -14,6 +14,7 @@ _GD = {"learning_rate": 0.01, "gradient_moment": 0.9, "weights_decay": 0.0005}
 
 DEFAULTS = {
     "loader": {
+        "data_dir": None,  # train/<subject>/*.png tree; synthetic when None
         "minibatch_size": 20,
         "n_train": 480,
         "n_test": 96,
@@ -34,15 +35,26 @@ def build_workflow(**overrides) -> StandardWorkflow:
     lcfg = cfg.loader
     side = lcfg.get("side", 32)
     n_classes = lcfg.get("n_classes", 15)
-    data, labels = datasets._synthetic_split(
-        lcfg.get("n_train", 480), lcfg.get("n_test", 96),
-        (side * side,), n_classes,
-    )
-    loader = FullBatchLoader(
-        data, labels,
-        minibatch_size=lcfg.get("minibatch_size", 20),
-        normalization="mean_disp",
-    )
+    data_dir = lcfg.get("data_dir") or root.common.get("data_dir")
+    if data_dir:
+        # real faces: train/<subject>/*.png tree, grayscale at side x side
+        from znicz_tpu.models import grayscale_image_dir_loader
+
+        loader = grayscale_image_dir_loader(
+            data_dir, side=side,
+            minibatch_size=lcfg.get("minibatch_size", 20),
+        )
+        n_classes = len(loader.classes)
+    else:
+        data, labels = datasets._synthetic_split(
+            lcfg.get("n_train", 480), lcfg.get("n_test", 96),
+            (side * side,), n_classes,
+        )
+        loader = FullBatchLoader(
+            data, labels,
+            minibatch_size=lcfg.get("minibatch_size", 20),
+            normalization="mean_disp",
+        )
     layers = cfg.get("layers")
     layers[-1]["->"]["output_sample_shape"] = n_classes
     kwargs = merge_workflow_kwargs(

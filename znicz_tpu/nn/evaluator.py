@@ -80,7 +80,12 @@ def mse(
     and ``max_diff`` (largest absolute elementwise error).
     """
     mask, n_valid = _norm_mask(mask, output.shape[0])
-    diff = (output - target).reshape(output.shape[0], -1)
+    # flatten BEFORE subtracting: a flat model output vs a spatial target
+    # (e.g. an MLP autoencoder reconstructing [H, W, C] images) must
+    # compare by total feature count, not broadcast
+    diff = output.reshape(output.shape[0], -1) - target.reshape(
+        target.shape[0], -1
+    )
     per_sample = jnp.mean(jnp.square(diff), axis=1)
     loss = jnp.sum(per_sample * mask) / n_valid
     # "loss" IS the mse; no duplicate key, so epoch aggregation (mean of

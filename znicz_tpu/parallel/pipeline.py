@@ -186,6 +186,9 @@ def pipeline_apply(
         # stages sharded; microbatch STORE sharded chunk-per-device
         in_specs=(param_specs, P(axis)),
         out_specs=P(axis),
+        # stage_fn may contain pallas_calls (e.g. flash attention), whose
+        # out_shapes carry no varying-mesh-axes annotation
+        check_vma=False,
     )
     out = fn(stacked_params, micro)[:n_microbatches]
     return out.reshape((b,) + out.shape[2:])

@@ -51,6 +51,7 @@ class StandardWorkflow(Workflow):
         compute_dtype: Optional[Any] = None,
         prefetch_batches: int = 2,
         parallel=None,
+        epoch_dispatch: str = "auto",
         rand_name: str = "default",
         name: str = "StandardWorkflow",
     ):
@@ -93,5 +94,6 @@ class StandardWorkflow(Workflow):
             lr_policy=policy,
             prefetch_batches=prefetch_batches,
             parallel=parallel,
+            epoch_dispatch=epoch_dispatch,
             name=name,
         )

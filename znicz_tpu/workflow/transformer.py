@@ -134,7 +134,7 @@ def stack_lm_blocks(params, n_stages: int):
 
 
 def lm_apply_pipelined(
-    params_pp, tokens, *, n_heads, mesh, n_microbatches
+    params_pp, tokens, *, n_heads, mesh, n_microbatches, attention_fn=None
 ):
     """tokens [B, T] -> logits, with the block tower pipelined over the
     mesh's ``pipe`` axis (embed/head run outside the shard_map)."""
@@ -145,7 +145,9 @@ def lm_apply_pipelined(
 
     def stage_fn(blocks, x):
         for block in blocks:  # this stage's group of transformer blocks
-            x = _block_forward(block, x, n_heads=n_heads)
+            x = _block_forward(
+                block, x, n_heads=n_heads, attention_fn=attention_fn
+            )
         return x
 
     def head_fn(p, x):
@@ -211,6 +213,7 @@ class TransformerLMWorkflow(Workflow):
         n_heads: int = 4,
         max_epochs: int = 10,
         hyper: Optional[optimizer.HyperParams] = None,
+        attention: str = "auto",  # "dot" | "flash" | "auto"
         sequence_parallel: bool = False,
         tensor_parallel: bool = False,
         pipeline_parallel: bool = False,
@@ -248,6 +251,7 @@ class TransformerLMWorkflow(Workflow):
             learning_rate=0.1, gradient_moment=0.9
         )
         self.rand_name = rand_name
+        self.attention = attention
         self.sequence_parallel = sequence_parallel
         self.tensor_parallel = tensor_parallel
         self.pipeline_parallel = pipeline_parallel
@@ -312,11 +316,34 @@ class TransformerLMWorkflow(Workflow):
         return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
 
     def _attention_fn(self):
-        if not self.sequence_parallel:
-            return None
-        from znicz_tpu.parallel.ring_attention import ring_attention
+        if self.sequence_parallel:
+            from znicz_tpu.parallel.ring_attention import ring_attention
 
-        return partial(ring_attention, mesh=self.mesh)
+            return partial(ring_attention, mesh=self.mesh)
+        # blockwise flash kernel (ops/pallas/attention.py): O(T·D) memory
+        # and VMEM-resident online softmax — the long-context default on
+        # TPU once the quadratic score matrix stops being a rounding error.
+        # Under DataParallel the jitted step is GSPMD-sharded and a
+        # pallas_call has no partitioning rule, so auto never picks flash
+        # there and an explicit request is rejected up front (pipeline
+        # parallel is fine — its shard_map runs per-device code).
+        if self.attention == "flash" and self.parallel is not None:
+            raise ValueError(
+                "attention='flash' cannot run inside a DataParallel-"
+                "sharded step (no GSPMD partitioning rule for the pallas "
+                "kernel); use sequence_parallel ring attention to scale "
+                "attention over devices"
+            )
+        if self.attention == "flash" or (
+            self.attention == "auto"
+            and self.parallel is None
+            and jax.default_backend() in ("tpu", "axon")
+            and self.max_seq >= 512
+        ):
+            from znicz_tpu.ops.pallas.attention import flash_attention
+
+            return flash_attention
+        return None
 
     def _build_steps(self):
         n_heads = self.n_heads
@@ -328,6 +355,7 @@ class TransformerLMWorkflow(Workflow):
                 n_heads=n_heads,
                 mesh=self.mesh,
                 n_microbatches=self.pipeline_microbatches,
+                attention_fn=attention_fn,
             )
         else:
             apply_fn = partial(

@@ -84,6 +84,7 @@ class Workflow(Logger):
         lr_policy: Optional[Callable[[float, int], float]] = None,
         parallel=None,
         prefetch_batches: int = 2,
+        epoch_dispatch: str = "auto",  # "auto" | "scan" | "step"
         name: str = "workflow",
     ):
         self.loader = loader
@@ -97,6 +98,7 @@ class Workflow(Logger):
         self.lr_policy = lr_policy
         self.parallel = parallel  # DataParallel placement policy, or None
         self.prefetch_batches = prefetch_batches  # 0 disables the loader thread
+        self.epoch_dispatch = epoch_dispatch
         self.services = []  # per-epoch observers: plotters, status, image saver
         self.name = name
         self.state: Optional[TrainState] = None
@@ -236,6 +238,36 @@ class Workflow(Logger):
         self.train_step_fn = train_step
         self._train_step = jax.jit(train_acc, donate_argnums=(0, 5))
         self._eval_step = jax.jit(eval_acc, donate_argnums=(4,))
+
+        # whole-split lax.scan twins: ONE dispatch per split per epoch.
+        # For device-resident loaders the per-batch payload is an index
+        # vector, so stacking an epoch of them is bytes — and per-step
+        # dispatch latency (seconds per round trip through remote relays)
+        # drops out of the epoch entirely (see run_epoch's scan path).
+        def train_epoch_scan(state, xs, ys, masks, lrs, acc, ctx):
+            def body(carry, b):
+                st, a = carry
+                x, y, mask, lr = b
+                st, a = train_acc(st, x, y, mask, lr, a, ctx)
+                return (st, a), None
+
+            (state, acc), _ = jax.lax.scan(
+                body, (state, acc), (xs, ys, masks, lrs)
+            )
+            return state, acc
+
+        def eval_epoch_scan(params, xs, ys, masks, acc, ctx):
+            def body(a, b):
+                x, y, mask = b
+                return eval_acc(params, x, y, mask, a, ctx), None
+
+            acc, _ = jax.lax.scan(body, acc, (xs, ys, masks))
+            return acc
+
+        self._train_epoch_scan = jax.jit(
+            train_epoch_scan, donate_argnums=(0, 5)
+        )
+        self._eval_epoch_scan = jax.jit(eval_epoch_scan, donate_argnums=(4,))
         if eval_conf_step is not None:
 
             def eval_conf_acc(params, x, y, mask, acc, conf, ctx):
@@ -320,10 +352,75 @@ class Workflow(Logger):
         }
 
     # ------------------------------------------------------------------
+    def _use_epoch_scan(self) -> bool:
+        """Scan dispatch: whole splits compiled as one lax.scan.  Auto mode
+        requires a device-resident loader (per-batch host payloads are bare
+        index vectors) and no DataParallel placement (stacked batches would
+        need a dim-1 sharding rule)."""
+        if self.epoch_dispatch == "scan":
+            if self.parallel is not None:
+                raise ValueError(
+                    "epoch_dispatch='scan' cannot combine with a "
+                    "DataParallel placement: the stacked batches would "
+                    "bypass shard_batch (no dim-1 sharding rule yet)"
+                )
+            return True
+        return (
+            self.epoch_dispatch == "auto"
+            and self._ctx is not None
+            and getattr(self.loader, "epoch_scan_friendly", False)
+            and self.parallel is None
+        )
+
+    def _run_epoch_scanned(self) -> Dict[str, jax.Array]:
+        """One dispatch per split: stack the epoch's host-side batch
+        payloads and scan.  Split order (train, valid, test) matches the
+        stepwise path, so results are identical."""
+        per_split: Dict[str, list] = {}
+        for split, mb in self.loader.epoch():
+            per_split.setdefault(split, []).append(mb)
+        accs: Dict[str, jax.Array] = {}
+        for split, mbs in per_split.items():
+            xs = jnp.asarray(np.stack([mb.data for mb in mbs]))
+            ys = (
+                xs
+                if self.target == "input"
+                else jnp.asarray(
+                    np.stack([self._batch_target(mb) for mb in mbs])
+                )
+            )
+            masks = jnp.asarray(np.stack([mb.mask for mb in mbs]))
+            with self.timer.phase(f"dispatch/{split}"):
+                if split == TRAIN:
+                    lrs = jnp.asarray(
+                        [
+                            self.lr_policy(1.0, self._host_step + i)
+                            if self.lr_policy
+                            else 1.0
+                            for i in range(len(mbs))
+                        ],
+                        jnp.float32,
+                    )
+                    self.state, acc = self._train_epoch_scan(
+                        self.state, xs, ys, masks, lrs,
+                        self._acc_init(), self._ctx,
+                    )
+                    self._host_step += len(mbs)
+                else:
+                    acc = self._eval_epoch_scan(
+                        self.state.params, xs, ys, masks,
+                        self._acc_init(), self._ctx,
+                    )
+                accs[split] = acc
+        return accs
+
     def run_epoch(self) -> Dict[str, Any]:
         """One full epoch over all splits; returns the Decision verdict."""
         if self.state is None:
             self.initialize()
+        if self._use_epoch_scan():
+            accs = self._run_epoch_scanned()
+            return self._finish_epoch(accs)
         accs: Dict[str, jax.Array] = {}  # per-split on-device accumulators
         put = (
             self.parallel.shard_batch if self.parallel is not None else jnp.asarray
@@ -369,6 +466,9 @@ class Workflow(Logger):
                         self.state.params, x, y, mask, acc, self._ctx
                     )
                 accs[split] = acc
+        return self._finish_epoch(accs)
+
+    def _finish_epoch(self, accs: Dict[str, jax.Array]) -> Dict[str, Any]:
         with self.timer.phase("metrics_sync"):
             # one tiny existing-buffer fetch per split (no per-batch syncs)
             for split, acc in accs.items():
