@@ -81,6 +81,49 @@ class TestEpochScanDispatch:
             rtol=1e-6, atol=1e-7,
         )
 
+    def test_scan_under_data_parallel_matches_stepwise(self):
+        # stacked payloads shard on the batch dim: scan+DP == step+DP
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.parallel import DataParallel, make_mesh
+
+        gen = np.random.default_rng(2)
+        images = gen.integers(0, 256, (96, 8, 8, 1), dtype=np.uint8)
+        labels = (images.mean(axis=(1, 2, 3)) > 127).astype(np.int32)
+
+        def build_and_run(dispatch):
+            prng.seed_all(23)
+            loader = FullBatchLoader(
+                {"train": images}, {"train": labels},
+                minibatch_size=32,
+                normalization="range",
+                normalization_kwargs={"scale": 255.0, "shift": -0.5},
+                device_resident=True,
+            )
+            wf = StandardWorkflow(
+                loader,
+                [{"type": "all2all_tanh",
+                  "->": {"output_sample_shape": 8}},
+                 {"type": "softmax", "->": {"output_sample_shape": 2}}],
+                decision_config={"max_epochs": 2},
+                default_hyper={"learning_rate": 0.1,
+                               "gradient_moment": 0.9},
+                epoch_dispatch=dispatch,
+                parallel=DataParallel(make_mesh(8, 1)),
+            )
+            wf.initialize(seed=23)
+            if dispatch == "auto":
+                assert wf._use_epoch_scan()
+            return wf.run().history
+
+        a = build_and_run("auto")
+        b = build_and_run("step")
+        for ea, eb in zip(a, b):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"],
+                rtol=1e-5, atol=1e-7,
+            )
+            assert ea["train"]["n_err"] == eb["train"]["n_err"]
+
 
 class TestModelBuilder:
     def test_mlp_shapes(self):

@@ -139,11 +139,14 @@ def pipeline_apply(
     mesh: Mesh,
     n_microbatches: int,
     axis: str = PIPE_AXIS,
+    check_vma: bool = True,
 ) -> jnp.ndarray:
     """Run x [B, F] through the stacked stages, pipelined over ``mesh[axis]``.
 
     ``apply_one(stage_params, x_mb)`` applies ONE stage to one microbatch.
-    B must divide by ``n_microbatches``.
+    B must divide by ``n_microbatches``.  Set ``check_vma=False`` only when
+    ``apply_one`` contains pallas_calls (their out_shapes carry no
+    varying-mesh-axes annotation) — it disables shard_map's safety check.
     """
     n_stages = mesh.shape[axis]
     stage_dims = {
@@ -186,9 +189,7 @@ def pipeline_apply(
         # stages sharded; microbatch STORE sharded chunk-per-device
         in_specs=(param_specs, P(axis)),
         out_specs=P(axis),
-        # stage_fn may contain pallas_calls (e.g. flash attention), whose
-        # out_shapes carry no varying-mesh-axes annotation
-        check_vma=False,
+        check_vma=check_vma,
     )
     out = fn(stacked_params, micro)[:n_microbatches]
     return out.reshape((b,) + out.shape[2:])
@@ -204,6 +205,7 @@ def pipelined_model_apply(
     mesh: Mesh,
     n_microbatches: int,
     axis: str = PIPE_AXIS,
+    check_vma: bool = True,
 ) -> jnp.ndarray:
     """Embed -> pipelined tower -> head: the real-model decomposition
     (VERDICT r1 weak #4).  ``params`` = {"embed", "stages", "head"}; embed
@@ -213,7 +215,7 @@ def pipelined_model_apply(
     h = pipeline_apply(
         params["stages"], h,
         apply_one=stage_fn, mesh=mesh,
-        n_microbatches=n_microbatches, axis=axis,
+        n_microbatches=n_microbatches, axis=axis, check_vma=check_vma,
     )
     return head_fn(params["head"], h)
 

@@ -157,6 +157,9 @@ def lm_apply_pipelined(
         params_pp, tokens,
         embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
         mesh=mesh, n_microbatches=n_microbatches,
+        # flash attention inside the stage is a pallas_call: no vma
+        # annotation on its out_shapes, so the check must be off for it
+        check_vma=attention_fn is None,
     )
 
 
@@ -317,6 +320,13 @@ class TransformerLMWorkflow(Workflow):
 
     def _attention_fn(self):
         if self.sequence_parallel:
+            if self.attention == "flash":
+                raise ValueError(
+                    "attention='flash' cannot combine with "
+                    "sequence_parallel=True: ring attention owns the "
+                    "sequence axis (its per-shard blocks are computed "
+                    "in-loop, not by the flash kernel)"
+                )
             from znicz_tpu.parallel.ring_attention import ring_attention
 
             return partial(ring_attention, mesh=self.mesh)

@@ -98,6 +98,11 @@ class Workflow(Logger):
         self.lr_policy = lr_policy
         self.parallel = parallel  # DataParallel placement policy, or None
         self.prefetch_batches = prefetch_batches  # 0 disables the loader thread
+        if epoch_dispatch not in ("auto", "scan", "step"):
+            raise ValueError(
+                f"epoch_dispatch={epoch_dispatch!r}: "
+                "want 'auto', 'scan' or 'step'"
+            )
         self.epoch_dispatch = epoch_dispatch
         self.services = []  # per-epoch observers: plotters, status, image saver
         self.name = name
@@ -355,21 +360,42 @@ class Workflow(Logger):
     def _use_epoch_scan(self) -> bool:
         """Scan dispatch: whole splits compiled as one lax.scan.  Auto mode
         requires a device-resident loader (per-batch host payloads are bare
-        index vectors) and no DataParallel placement (stacked batches would
-        need a dim-1 sharding rule)."""
+        index vectors); under DataParallel the stacked payloads shard on
+        their BATCH dim (dim 1) so each scan step sees the same sharded
+        batch the stepwise path would."""
         if self.epoch_dispatch == "scan":
-            if self.parallel is not None:
+            if not getattr(self.loader, "epoch_scan_friendly", False):
                 raise ValueError(
-                    "epoch_dispatch='scan' cannot combine with a "
-                    "DataParallel placement: the stacked batches would "
-                    "bypass shard_batch (no dim-1 sharding rule yet)"
+                    "epoch_dispatch='scan' needs a scan-friendly loader "
+                    "(per-batch host payloads must be small, e.g. "
+                    "FullBatchLoader(device_resident=True)); a streaming "
+                    "loader would materialize the whole epoch in host RAM"
                 )
             return True
         return (
             self.epoch_dispatch == "auto"
             and self._ctx is not None
             and getattr(self.loader, "epoch_scan_friendly", False)
-            and self.parallel is None
+        )
+
+    def _put_stacked(self, arr: np.ndarray) -> jax.Array:
+        """Device-place an epoch-stacked [n_steps, B, ...] payload; under
+        DataParallel the batch dim (dim 1) shards over the data axis."""
+        if self.parallel is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from znicz_tpu.parallel.mesh import DATA_AXIS
+
+        if arr.shape[1] % self.parallel.n_data:
+            raise ValueError(
+                f"batch {arr.shape[1]} not divisible by data axis "
+                f"{self.parallel.n_data}; choose minibatch_size as a "
+                "multiple"
+            )
+        spec = P(None, DATA_AXIS, *([None] * (arr.ndim - 2)))
+        return jax.device_put(
+            arr, NamedSharding(self.parallel.mesh, spec)
         )
 
     def _run_epoch_scanned(self) -> Dict[str, jax.Array]:
@@ -381,15 +407,15 @@ class Workflow(Logger):
             per_split.setdefault(split, []).append(mb)
         accs: Dict[str, jax.Array] = {}
         for split, mbs in per_split.items():
-            xs = jnp.asarray(np.stack([mb.data for mb in mbs]))
+            xs = self._put_stacked(np.stack([mb.data for mb in mbs]))
             ys = (
                 xs
                 if self.target == "input"
-                else jnp.asarray(
+                else self._put_stacked(
                     np.stack([self._batch_target(mb) for mb in mbs])
                 )
             )
-            masks = jnp.asarray(np.stack([mb.mask for mb in mbs]))
+            masks = self._put_stacked(np.stack([mb.mask for mb in mbs]))
             with self.timer.phase(f"dispatch/{split}"):
                 if split == TRAIN:
                     lrs = jnp.asarray(
