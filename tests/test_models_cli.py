@@ -236,6 +236,53 @@ class TestLauncherCLI:
         )
         assert launcher.result.epoch == 3
 
+    def test_evaluate_only_mode(self, tmp_path, capsys):
+        # the reference's test-mode run: restore a snapshot, evaluate one
+        # split with the confusion matrix, no training
+        import json
+
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        run_args(
+            [
+                str(wf_py),
+                "--random-seed", "7",
+                "--stop-after", "3",
+                "--snapshot-dir", str(tmp_path / "snaps"),
+            ]
+        )
+        best = tmp_path / "snaps" / "WineWorkflow_best.pickle.gz"
+        launcher = run_args(
+            [
+                str(wf_py),
+                "--snapshot", str(best),
+                "--evaluate", "train",
+            ]
+        )
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["split"] == "train"
+        assert out["n_samples"] > 0
+        assert 0.0 <= out["err_pct"] <= 100.0
+        conf = np.asarray(out["confusion"])
+        assert conf.shape == (3, 3)  # wine has 3 classes
+        assert conf.sum() == out["n_samples"]
+        # no training happened: result is the eval dict, not a Decision
+        assert launcher.result["err_pct"] == out["err_pct"]
+
+    def test_evaluate_missing_split_errors(self, tmp_path):
+        # wine has no test split: a silent 0-sample "perfect" evaluation
+        # must be a hard error, and --optimize conflicts up front
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        with pytest.raises(SystemExit, match="no samples"):
+            run_args([str(wf_py), "--evaluate", "test"])
+        with pytest.raises(SystemExit, match="conflict"):
+            run_args([str(wf_py), "--optimize", "1", "--evaluate"])
+
     def test_export_flag(self, tmp_path):
         wf_py = tmp_path / "wf.py"
         wf_py.write_text(

@@ -88,6 +88,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--export", default=None, metavar="MODEL.znicz",
                    help="after training, export the model for the native "
                         "inference engine (native/znicz_infer)")
+    p.add_argument("--evaluate", nargs="?", const="test", default=None,
+                   metavar="SPLIT",
+                   help="evaluation-only mode (reference test runs): build "
+                        "the workflow, restore --snapshot if given, run one "
+                        "evaluation pass over SPLIT (default: test) with the "
+                        "confusion matrix, print a JSON summary and exit "
+                        "without training")
     p.add_argument("--dry-run", action="store_true",
                    help="build and initialize the workflow, run nothing")
     p.add_argument("--verbose", action="store_true")
@@ -185,18 +192,45 @@ class Launcher(Logger):
         if self.args.dry_run:
             self.info("dry run: workflow initialized, skipping run()")
             return None
+        if self.args.evaluate:
+            import json
+
+            import numpy as np
+
+            split = self.args.evaluate
+            # an absent/misspelled split would "evaluate" zero samples and
+            # print a perfect score — fail loudly instead
+            if self.workflow.loader.class_lengths.get(split, 0) == 0:
+                raise SystemExit(
+                    f"--evaluate {split}: the loader has no samples in "
+                    f"that split (available: "
+                    f"{sorted(k for k, n in self.workflow.loader.class_lengths.items() if n)})"
+                )
+            result = self.workflow.evaluate(split, confusion=True)
+            conf = result.pop("confusion", None)
+            if conf is not None:
+                result["confusion"] = np.asarray(conf).tolist()
+            result["split"] = split
+            print(json.dumps(result))
+            self.result = result
+            self._maybe_export()  # a restored model exports w/o training
+            return self.result
         self.result = self.workflow.run()
-        if self.args.export:
-            import jax
-
-            from znicz_tpu.export import export_model
-
-            trained = self.workflow.model._replace(
-                params=jax.device_get(self.workflow.state.params)
-            )
-            export_model(trained, self.args.export)
-            self.info("exported trained model to %s", self.args.export)
+        self._maybe_export()
         return self.result
+
+    def _maybe_export(self) -> None:
+        if not self.args.export:
+            return
+        import jax
+
+        from znicz_tpu.export import export_model
+
+        trained = self.workflow.model._replace(
+            params=jax.device_get(self.workflow.state.params)
+        )
+        export_model(trained, self.args.export)
+        self.info("exported trained model to %s", self.args.export)
 
 
 def run_args(argv=None) -> Launcher:
@@ -241,6 +275,11 @@ def run_args(argv=None) -> Launcher:
             "(reference workflow convention)"
         )
     if args.optimize:
+        if args.evaluate:
+            raise SystemExit(
+                "--optimize and --evaluate conflict: the genetic search "
+                "needs training runs, evaluation mode skips them"
+            )
         from znicz_tpu.genetics import find_tunables, optimize_workflow
 
         # collect the search space BEFORE any probe: workflow modules may

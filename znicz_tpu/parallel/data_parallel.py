@@ -57,17 +57,25 @@ class DataParallel:
         return self.mesh.shape[DATA_AXIS]
 
     # -- batches -----------------------------------------------------------
-    def shard_batch(self, arr) -> jax.Array:
-        """Place a host batch sharded over the data axis (batch dim 0 must
-        divide by the axis size; the loader's padded static batches ensure a
-        constant batch size, so pick minibatch_size accordingly)."""
+    def shard_batch(self, arr, *, batch_dim: int = 0) -> jax.Array:
+        """Place a host batch sharded over the data axis (the batch dim
+        must divide by the axis size; the loader's padded static batches
+        ensure a constant batch size, so pick minibatch_size accordingly).
+        ``batch_dim=1`` serves epoch-stacked [n_steps, B, ...] payloads
+        (the workflow's scanned dispatch)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         arr = np.asarray(arr)
-        if arr.shape[0] % self.n_data:
+        if arr.shape[batch_dim] % self.n_data:
             raise ValueError(
-                f"batch {arr.shape[0]} not divisible by data axis "
+                f"batch {arr.shape[batch_dim]} not divisible by data axis "
                 f"{self.n_data}; choose minibatch_size as a multiple"
             )
-        return jax.device_put(arr, data_sharding(self.mesh, arr.ndim))
+        if batch_dim == 0:
+            return jax.device_put(arr, data_sharding(self.mesh, arr.ndim))
+        spec = [None] * arr.ndim
+        spec[batch_dim] = DATA_AXIS
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
 
     # -- params ------------------------------------------------------------
     def _param_spec(self, path: str, leaf) -> P:

@@ -380,23 +380,11 @@ class Workflow(Logger):
 
     def _put_stacked(self, arr: np.ndarray) -> jax.Array:
         """Device-place an epoch-stacked [n_steps, B, ...] payload; under
-        DataParallel the batch dim (dim 1) shards over the data axis."""
+        DataParallel the batch dim (dim 1) shards over the data axis —
+        placement policy stays with DataParallel."""
         if self.parallel is None:
             return jnp.asarray(arr)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from znicz_tpu.parallel.mesh import DATA_AXIS
-
-        if arr.shape[1] % self.parallel.n_data:
-            raise ValueError(
-                f"batch {arr.shape[1]} not divisible by data axis "
-                f"{self.parallel.n_data}; choose minibatch_size as a "
-                "multiple"
-            )
-        spec = P(None, DATA_AXIS, *([None] * (arr.ndim - 2)))
-        return jax.device_put(
-            arr, NamedSharding(self.parallel.mesh, spec)
-        )
+        return self.parallel.shard_batch(arr, batch_dim=1)
 
     def _run_epoch_scanned(self) -> Dict[str, jax.Array]:
         """One dispatch per split: stack the epoch's host-side batch
