@@ -83,6 +83,27 @@ def test_status_writer(tmp_path):
     assert "<table>" in (tmp_path / "status.html").read_text()
 
 
+def test_interactive_shell_service(tmp_path, monkeypatch):
+    # the Shell epoch service drops into code.interact with the live
+    # workflow in scope, at the configured cadence
+    import znicz_tpu.interaction as interaction
+
+    calls = []
+    monkeypatch.setattr(
+        interaction.code, "interact",
+        lambda banner, local, exitmsg: calls.append(local),
+    )
+    prng.seed_all(4)
+    shell = interaction.Shell(every_n_epochs=2)
+    shell.enabled = True  # tests have no tty
+    wf = _wf(tmp_path, [shell], max_epochs=4)
+    wf.run()
+    assert len(calls) == 2  # epochs 0 and 2
+    assert calls[0]["wf"] is wf
+    assert calls[0]["state"] is not None
+    assert "verdict" in calls[0]
+
+
 def test_status_page_embeds_plot_pngs(tmp_path):
     # watch-while-training: plotters writing into the status dir appear as
     # auto-refreshed <img> tags (the live-plot story, SURVEY 2.1 graphics)

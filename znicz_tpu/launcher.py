@@ -198,15 +198,12 @@ class Launcher(Logger):
             import numpy as np
 
             split = self.args.evaluate
-            # an absent/misspelled split would "evaluate" zero samples and
-            # print a perfect score — fail loudly instead
-            if self.workflow.loader.class_lengths.get(split, 0) == 0:
-                raise SystemExit(
-                    f"--evaluate {split}: the loader has no samples in "
-                    f"that split (available: "
-                    f"{sorted(k for k, n in self.workflow.loader.class_lengths.items() if n)})"
-                )
-            result = self.workflow.evaluate(split, confusion=True)
+            try:
+                # Workflow.evaluate rejects empty/misspelled splits (a
+                # zero-sample evaluation would print a perfect score)
+                result = self.workflow.evaluate(split, confusion=True)
+            except ValueError as e:
+                raise SystemExit(f"--evaluate: {e}") from None
             conf = result.pop("confusion", None)
             if conf is not None:
                 result["confusion"] = np.asarray(conf).tolist()

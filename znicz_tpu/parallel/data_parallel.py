@@ -24,7 +24,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from znicz_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
-    data_sharding,
     make_mesh,
     replicated,
 )
@@ -63,16 +62,12 @@ class DataParallel:
         ensure a constant batch size, so pick minibatch_size accordingly).
         ``batch_dim=1`` serves epoch-stacked [n_steps, B, ...] payloads
         (the workflow's scanned dispatch)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         arr = np.asarray(arr)
         if arr.shape[batch_dim] % self.n_data:
             raise ValueError(
                 f"batch {arr.shape[batch_dim]} not divisible by data axis "
                 f"{self.n_data}; choose minibatch_size as a multiple"
             )
-        if batch_dim == 0:
-            return jax.device_put(arr, data_sharding(self.mesh, arr.ndim))
         spec = [None] * arr.ndim
         spec[batch_dim] = DATA_AXIS
         return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))

@@ -516,6 +516,13 @@ class Workflow(Logger):
         """
         if self.state is None:
             self.initialize()
+        if self.loader.class_lengths.get(split, 0) == 0:
+            # evaluating zero samples would report a silent perfect score
+            raise ValueError(
+                f"evaluate({split!r}): the loader has no samples in that "
+                "split (available: "
+                f"{sorted(k for k, n in self.loader.class_lengths.items() if n)})"
+            )
         use_conf = (
             confusion
             and self.loss_function == "softmax"
