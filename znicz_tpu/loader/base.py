@@ -49,6 +49,10 @@ class Loader:
         self.rand_name = rand_name
         self._order: Dict[str, np.ndarray] = {}
         self.epoch_number = 0
+        # multi-host sample shard (Loader.set_process_shard): this process
+        # serves only its contiguous row range of every global minibatch
+        self.process_index = 0
+        self.process_count = 1
 
     # -- subclass interface ------------------------------------------------
     @property
@@ -86,6 +90,27 @@ class Loader:
         step as an ARGUMENT — never a closure constant, which XLA would
         embed into the compiled executable."""
         return None
+
+    def set_process_shard(self, index: int, count: int) -> None:
+        """Multi-host sample sharding (the reference's job-assignment
+        semantics, SURVEY.md 3.4: the master handed each slave an index
+        range; here every process derives its own range deterministically).
+
+        All processes compute the IDENTICAL global epoch order (the named
+        PRNG is seeded the same everywhere), then each serves only rows
+        ``[index*B/count, (index+1)*B/count)`` of every global minibatch —
+        exactly the rows its addressable devices own under data-parallel
+        sharding, so ``DataParallel.shard_batch`` can assemble the global
+        batch with zero cross-host data movement."""
+        if not 0 <= index < count:
+            raise ValueError(f"process {index} outside [0, {count})")
+        if self.max_minibatch_size % count:
+            raise ValueError(
+                f"minibatch_size {self.max_minibatch_size} not divisible "
+                f"by process_count {count}"
+            )
+        self.process_index = int(index)
+        self.process_count = int(count)
 
     # -- serving -----------------------------------------------------------
     def n_minibatches(self, split: str) -> int:
@@ -140,15 +165,22 @@ class Loader:
             self.reshuffle(split)
         order = self._split_order(split)
         bs = self.max_minibatch_size
+        # multi-host: this process fills only its contiguous row range of
+        # each global minibatch (mask is computed globally, then sliced, so
+        # padding rows stay masked no matter which process holds them)
+        lo = self.process_index * bs // self.process_count
+        hi = (self.process_index + 1) * bs // self.process_count
         for start in range(0, n, bs):
             idx = order[start : start + bs]
             n_valid = len(idx)
             if n_valid < bs:  # pad by repeating the first index; mask it out
                 pad = np.full(bs - n_valid, idx[0] if n_valid else 0)
                 idx = np.concatenate([idx, pad])
-            mb = self.fill(idx, split)
             mask = np.zeros(bs, np.float32)
             mask[:n_valid] = 1.0
+            if self.process_count > 1:
+                idx, mask = idx[lo:hi], mask[lo:hi]
+            mb = self.fill(idx, split)
             yield mb._replace(mask=mask, indices=idx)
 
     def epoch(self) -> Iterator[tuple]:

@@ -61,8 +61,33 @@ class DataParallel:
         must divide by the axis size; the loader's padded static batches
         ensure a constant batch size, so pick minibatch_size accordingly).
         ``batch_dim=1`` serves epoch-stacked [n_steps, B, ...] payloads
-        (the workflow's scanned dispatch)."""
+        (the workflow's scanned dispatch).
+
+        Multi-host (process_count > 1): ``arr`` is this process's LOCAL
+        slice of the global batch — the loader's per-process shard contract
+        (Loader.set_process_shard) serves each process rows
+        ``[p*B/P, (p+1)*B/P)`` of every global minibatch, the same rows its
+        addressable mesh devices own.  The pieces are assembled into ONE
+        global array without any cross-host data movement (the reference's
+        master never re-collected sample tensors either — SURVEY.md 3.4
+        assigns index ranges to slaves)."""
         arr = np.asarray(arr)
+        nproc = jax.process_count()
+        if nproc > 1:
+            gshape = list(arr.shape)
+            gshape[batch_dim] *= nproc
+            if gshape[batch_dim] % self.n_data:
+                raise ValueError(
+                    f"global batch {gshape[batch_dim]} not divisible by "
+                    f"data axis {self.n_data}"
+                )
+            spec = [None] * arr.ndim
+            spec[batch_dim] = DATA_AXIS
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P(*spec)),
+                arr,
+                global_shape=tuple(gshape),
+            )
         if arr.shape[batch_dim] % self.n_data:
             raise ValueError(
                 f"batch {arr.shape[batch_dim]} not divisible by data axis "
@@ -71,6 +96,12 @@ class DataParallel:
         spec = [None] * arr.ndim
         spec[batch_dim] = DATA_AXIS
         return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+
+    def put_replicated(self, arr) -> jax.Array:
+        """Place identical-on-every-process host data fully replicated over
+        the mesh (epoch accumulators, loader device contexts) so jitted
+        steps see consistently-placed global arrays on multi-host jobs."""
+        return jax.device_put(arr, replicated(self.mesh))
 
     # -- params ------------------------------------------------------------
     def _param_spec(self, path: str, leaf) -> P:
@@ -91,11 +122,30 @@ class DataParallel:
 
     def shard_state(self, state):
         """Place a TrainState: params/velocity per policy, scalars/key
-        replicated."""
+        replicated.
+
+        Leaves go device->host->mesh: a numpy source is the one input kind
+        ``jax.device_put`` accepts for shardings that span non-addressable
+        devices (multi-host), and every process holds the identical values
+        (same seeds), so the host round-trip is also the correct global
+        placement.  One-time cost at initialize, not in the hot loop."""
+        import jax.numpy as jnp
+
+        def put(leaf, sharding):
+            if isinstance(leaf, jax.Array) and jnp.issubdtype(
+                leaf.dtype, jax.dtypes.prng_key
+            ):
+                data = jax.device_put(
+                    np.asarray(jax.random.key_data(leaf)), sharding
+                )
+                return jax.random.wrap_key_data(
+                    data, impl=jax.random.key_impl(leaf)
+                )
+            return jax.device_put(np.asarray(leaf), sharding)
 
         def place(path, leaf):
             spec = self._param_spec(jax.tree_util.keystr(path), leaf)
-            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+            return put(leaf, NamedSharding(self.mesh, spec))
 
         params = jax.tree_util.tree_map_with_path(place, state.params)
         velocity = jax.tree_util.tree_map_with_path(place, state.velocity)
@@ -103,6 +153,6 @@ class DataParallel:
         return state._replace(
             params=params,
             velocity=velocity,
-            step=jax.device_put(state.step, rep),
-            key=jax.device_put(state.key, rep),
+            step=put(state.step, rep),
+            key=put(state.key, rep),
         )

@@ -53,3 +53,16 @@ def is_coordinator() -> bool:
     import jax
 
     return jax.process_index() == 0
+
+
+def process_count() -> int:
+    """Number of controller processes in the job (1 = single-host)."""
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()

@@ -36,6 +36,20 @@ class ImageSaver:
         model = workflow.model
         if not hasattr(model, "predict") or workflow.loss_function != "softmax":
             return
+        import jax
+
+        if jax.process_count() > 1:
+            # services run on the coordinator only, but a single process can
+            # neither run eager ops on globally-sharded params nor see the
+            # other hosts' loader shards — a per-epoch sample dump is not
+            # worth a collective, so the service declines once, loudly
+            if not getattr(self, "_warned_multihost", False):
+                self._warned_multihost = True
+                workflow.warning(
+                    "ImageSaver is disabled on multi-host runs (params span "
+                    "hosts; each loader only serves its own shard)"
+                )
+            return
         xs, probs, labels = [], [], []
         # shuffle=False: a service pass must not advance the shuffle stream
         for mb in workflow.loader.batches(self.split, shuffle=False):

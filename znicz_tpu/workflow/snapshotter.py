@@ -32,15 +32,26 @@ class _KeyLeaf(NamedTuple):
 
 
 def _to_host(tree):
+    def gather(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            if leaf.is_fully_replicated:  # local replica is the full value
+                return np.asarray(leaf.addressable_data(0))
+            # cross-host-sharded leaf (multi-host TP): every process joins
+            # the allgather, each ends with the full array
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(leaf, tiled=True)
+        return leaf
+
     def conv(leaf):
         if isinstance(leaf, jax.Array) and jnp.issubdtype(
             leaf.dtype, jax.dtypes.prng_key
         ):
             return _KeyLeaf(
-                np.asarray(jax.random.key_data(leaf)),
+                np.asarray(gather(jax.random.key_data(leaf))),
                 str(jax.random.key_impl(leaf)),
             )
-        return np.asarray(leaf)
+        return np.asarray(gather(leaf))
 
     return jax.tree_util.tree_map(conv, tree)
 
@@ -94,6 +105,10 @@ class Snapshotter:
         self.compress = compress
         self.interval = interval
         self.keep = keep
+        # multi-host: the Workflow sets writer=False on non-coordinator
+        # processes — they still participate in save()'s (possibly
+        # collective) device->host readback, but never touch the filesystem
+        self.writer = True
         os.makedirs(directory, exist_ok=True)
         # Recover periodic snapshots from a previous process so "keep at
         # most N" holds across restarts, oldest (lowest epoch tag) first.
@@ -125,10 +140,12 @@ class Snapshotter:
     ) -> str:
         payload = {
             "format_version": FORMAT_VERSION,
-            "train_state": _to_host(train_state),
+            "train_state": _to_host(train_state),  # collective on multi-host
             "host_state": host_state or {},
         }
         path = self._path(tag)
+        if not self.writer:
+            return path  # bookkeeping stays identical across processes
         opener = gzip.open if self.compress else open
         tmp = path + ".tmp"
         with opener(tmp, "wb") as f:

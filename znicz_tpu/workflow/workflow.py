@@ -288,7 +288,10 @@ class Workflow(Logger):
             self._eval_conf_step = None
 
     def _acc_init(self) -> jax.Array:
-        """Fresh epoch accumulator (plain transfer — no compile)."""
+        """Fresh epoch accumulator (plain transfer — no compile); placed
+        replicated over the mesh so multi-host steps see one global array."""
+        if self.parallel is not None:
+            return self.parallel.put_replicated(self._acc_init_host.copy())
         return jax.device_put(self._acc_init_host.copy())
 
     # ------------------------------------------------------------------
@@ -326,13 +329,46 @@ class Workflow(Logger):
             self.state = self._create_initial_state()
         if self.parallel is not None:
             self.state = self.parallel.shard_state(self.state)
+        # multi-host: every process runs this same loop; the loader serves
+        # per-process sample shards, snapshot/services write on exactly one
+        # process (the reference's master-does-bookkeeping role, SURVEY 3.4)
+        from znicz_tpu.parallel import multihost
+
+        self._coordinator = multihost.is_coordinator()
+        if multihost.process_count() > 1:
+            if self.parallel is None:
+                raise ValueError(
+                    "multi-host training needs a DataParallel placement "
+                    "policy (parallel=...) so batches span the global mesh"
+                )
+            if self.parallel.n_data % multihost.process_count():
+                # the per-process loader contract serves each process a
+                # contiguous 1/P block of every global minibatch — only
+                # meaningful when its devices own such a block of the axis
+                raise ValueError(
+                    f"data axis size {self.parallel.n_data} not divisible "
+                    f"by process count {multihost.process_count()}; "
+                    "multi-host training shards the batch over processes, "
+                    "so give every process an equal data-axis share "
+                    "(e.g. --mesh data=<n_processes*k>)"
+                )
+            self.loader.set_process_shard(
+                multihost.process_index(), multihost.process_count()
+            )
+        if self.snapshotter is not None:
+            self.snapshotter.writer = self._coordinator
         # host-side mirror of state.step: lr policies read it every minibatch
         # and must not force a device sync in the hot loop
         self._host_step = int(self.state.step)
         # loader-owned device context (e.g. HBM-resident dataset pool):
         # ONE up-front transfer, threaded through every step as an argument
         ctx_host = self.loader.device_context()
-        self._ctx = None if ctx_host is None else jax.device_put(ctx_host)
+        put_ctx = (
+            self.parallel.put_replicated
+            if self.parallel is not None
+            else jax.device_put
+        )
+        self._ctx = None if ctx_host is None else put_ctx(ctx_host)
         self._build_steps()
 
     def _batch_target(self, mb):
@@ -406,14 +442,19 @@ class Workflow(Logger):
             masks = self._put_stacked(np.stack([mb.mask for mb in mbs]))
             with self.timer.phase(f"dispatch/{split}"):
                 if split == TRAIN:
-                    lrs = jnp.asarray(
+                    lrs_host = np.asarray(
                         [
                             self.lr_policy(1.0, self._host_step + i)
                             if self.lr_policy
                             else 1.0
                             for i in range(len(mbs))
                         ],
-                        jnp.float32,
+                        np.float32,
+                    )
+                    lrs = (
+                        self.parallel.put_replicated(lrs_host)
+                        if self.parallel is not None
+                        else jnp.asarray(lrs_host)
                     )
                     self.state, acc = self._train_epoch_scan(
                         self.state, xs, ys, masks, lrs,
@@ -492,12 +533,17 @@ class Workflow(Logger):
                 )
         verdict = self.decision.on_epoch_end()
         if self.snapshotter is not None:
+            # called on EVERY process (the device->host readback may be a
+            # collective for cross-host-sharded params); only the writer
+            # process (coordinator) touches the filesystem
             self.snapshotter.maybe_save(
                 self.state,
                 self.host_state(),
                 epoch=self.decision.epoch - 1,
                 improved=verdict["improved"],
             )
+        if not getattr(self, "_coordinator", True):
+            return verdict  # services are host-side: coordinator-only
         for service in self.services:
             try:
                 service.on_epoch(self, verdict)
@@ -544,7 +590,12 @@ class Workflow(Logger):
             if use_conf:
                 if conf is None:
                     nc = int(np.prod(self.model.output_shape))
-                    conf = jax.device_put(np.zeros((nc, nc), np.int32))
+                    conf_host = np.zeros((nc, nc), np.int32)
+                    conf = (
+                        self.parallel.put_replicated(conf_host)
+                        if self.parallel is not None
+                        else jax.device_put(conf_host)
+                    )
                 acc, conf = self._eval_conf_step(
                     self.state.params, x, y, mask, acc, conf, self._ctx
                 )
