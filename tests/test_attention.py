@@ -87,3 +87,103 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
         )
+
+
+class TestFlashLse:
+    def test_lse_matches_dense_logsumexp(self):
+        from znicz_tpu.ops.pallas.attention import flash_attention_lse
+
+        q, k, v = _qkv(b=1, t=48, h=2, d=16, seed=3)
+        out, lse = flash_attention_lse(q, k, v, causal=True)
+        ref = attention.dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+        )
+        # reference logsumexp over the causal score rows
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        t = q.shape[1]
+        mask = np.tril(np.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        ref_lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, H, T]
+        np.testing.assert_allclose(
+            np.asarray(lse),
+            np.asarray(ref_lse.transpose(0, 2, 1)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_lse_gradient_flows(self):
+        """The lse OUTPUT must carry gradient (ring combination uses it)."""
+        from znicz_tpu.ops.pallas.attention import flash_attention_lse
+
+        q, k, v = _qkv(b=1, t=32, h=2, d=8, seed=5)
+
+        def loss(q, k, v):
+            out, lse = flash_attention_lse(q, k, v, causal=True)
+            return jnp.sum(jnp.square(out)) + jnp.sum(jnp.square(lse))
+
+        def ref_loss(q, k, v):
+            scale = 1.0 / np.sqrt(q.shape[-1])
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            t = q.shape[1]
+            mask = np.tril(np.ones((t, t), bool))
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+            lse = jax.scipy.special.logsumexp(s, axis=-1)
+            return jnp.sum(jnp.square(out)) + jnp.sum(jnp.square(lse))
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        rg = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, rg):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+
+class TestRingFlashInner:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_inner(self, causal):
+        mesh = make_mesh(8, 1)
+        q, k, v = _qkv(b=2, t=64, h=4, d=16, seed=13)
+        ref = ring_attention(q, k, v, mesh=mesh, causal=causal)
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=causal, inner="flash"
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+        )
+
+    def test_grads_match_single_device(self):
+        mesh = make_mesh(8, 1)
+        q, k, v = _qkv(b=1, t=64, h=2, d=8, seed=17)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v)))
+
+        g = jax.grad(
+            loss(
+                lambda q, k, v: ring_attention(
+                    q, k, v, mesh=mesh, causal=True, inner="flash"
+                )
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        rg = jax.grad(
+            loss(
+                lambda q, k, v: attention.dot_product_attention(
+                    q, k, v, causal=True
+                )
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g, rg):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+
+    def test_bad_inner_rejected(self):
+        mesh = make_mesh(8, 1)
+        q, k, v = _qkv(b=1, t=16, h=1, d=8)
+        with pytest.raises(ValueError, match="inner"):
+            ring_attention(q, k, v, mesh=mesh, inner="blockwise")

@@ -389,6 +389,31 @@ class TestPallasFlashTimingTPU:
         # measured 2.7x (v5e, T=2048); 1.2 margin absorbs relay noise
         assert t_flash * 1.2 < t_twin, (t_flash, t_twin)
 
+    def test_ring_flash_inner_beats_dense_inner(self):
+        # SP long context at kernel speed: the ring's per-shard block is the
+        # flash kernel.  One chip = one ring shard, which is exactly the
+        # per-device work a real N-chip ring would run (T_local = T/N).
+        from functools import partial
+
+        from znicz_tpu.parallel import make_mesh
+        from znicz_tpu.parallel.ring_attention import ring_attention
+
+        mesh = make_mesh(1, 1)
+        ks = jax.random.split(jax.random.key(1), 3)
+        q, k, v = (
+            jax.random.normal(kk, (1, 4096, 4, 64), jnp.float32)
+            for kk in ks
+        )
+
+        def chainable(inner):
+            fn = partial(ring_attention, mesh=mesh, causal=True, inner=inner)
+            g = jax.grad(lambda q: jnp.sum(fn(q, k, v)))
+            return lambda x: g(x)
+
+        t_dense = _device_ms_per_iter(chainable("dense"), q, n_inner=20)
+        t_flash = _device_ms_per_iter(chainable("flash"), q, n_inner=20)
+        assert t_flash * 1.2 < t_dense, (t_flash, t_dense)
+
 
 @pytest.mark.skipif(not ON_TPU, reason="hardware PRNG needs a chip")
 class TestPallasHardwareRNGTPU:

@@ -230,28 +230,35 @@ def _flash_fwd_impl(q, k, v, *, causal, scale, bq, bk, t_real):
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, bq, bk, t_real):
-    out, _ = _flash_fwd_impl(
+    """Returns (out, lse).  Exposing the logsumexp as a differentiable
+    OUTPUT (not just a backward residual) is what lets ring attention use
+    this kernel as its per-shard inner block: ring steps combine normalized
+    block outputs via their lse's, so the lse carries real gradient."""
+    return _flash_fwd_impl(
         q, k, v, causal=causal, scale=scale, bq=bq, bk=bk, t_real=t_real
     )
-    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, bq, bk, t_real):
     out, lse = _flash_fwd_impl(
         q, k, v, causal=causal, scale=scale, bq=bq, bk=bk, t_real=t_real
     )
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, bq, bk, t_real, res, dout):
+def _flash_bwd(causal, scale, bq, bk, t_real, res, cts):
+    dout, dlse = cts
     q, k, v, out, lse = res
     bh, t_pad, d = q.shape
     nq, nk = _blocks(t_pad, bq), _blocks(t_pad, bk)
-    # delta_i = rowsum(dout * out): tiny elementwise reduce, XLA fuses it
+    # delta_i = rowsum(dout * out): tiny elementwise reduce, XLA fuses it.
+    # An lse cotangent folds in for free: dL/ds_ij = p_ij*(dp_ij - delta_i)
+    # and d(lse_i)/ds_ij = p_ij, so ds = p*(dp - (delta - dlse)) — the
+    # existing kernels need only a corrected delta, not a new input.
     delta = jnp.sum(
         dout.astype(jnp.float32) * out.astype(jnp.float32),
         axis=-1, keepdims=True,
-    )
+    ) - dlse.astype(jnp.float32)
     common = dict(scale=scale, causal=causal, t_real=t_real, bq=bq, bk=bk)
     dq = pl.pallas_call(
         partial(_dq_kernel, **common),
@@ -296,6 +303,46 @@ def _bhtd(x):
     return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
 
+def flash_attention_lse(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale=None,
+    block_q: int = BLOCK_Q,
+    block_k: int = BLOCK_K,
+):
+    """Flash attention returning ``(out [B,T,H,D], lse [B,T,H])``.
+
+    The per-row logsumexp output is what makes the kernel composable as a
+    BLOCK of a larger softmax: ring attention rescales block outputs by
+    ``exp(lse_blk - lse_total)`` to merge shards of the key axis.  Fully
+    masked rows carry the NEG_INF-order sentinel (zero mass)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    b, t, h, d = q.shape
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    # pad so BOTH block sizes divide the padded length (unequal custom
+    # blocks would otherwise read out of bounds in the last block)
+    pad = (-t) % np.lcm(bq, bk)
+    qf, kf, vf = (_bhtd(x) for x in (q, k, v))
+    if pad:
+        qf, kf, vf = (
+            jnp.pad(x, ((0, 0), (0, pad), (0, 0))) for x in (qf, kf, vf)
+        )
+    out, lse = _flash(qf, kf, vf, causal, float(scale), bq, bk, t)
+    out = (
+        out[:, :t]
+        .reshape(b, h, t, d)
+        .transpose(0, 2, 1, 3)
+        .astype(q.dtype)
+    )
+    lse = lse[:, :t, 0].reshape(b, h, t).transpose(0, 2, 1)  # [B, T, H]
+    return out, lse
+
+
 def flash_attention(
     q: jnp.ndarray,  # [B, T, H, D]
     k: jnp.ndarray,
@@ -307,21 +354,8 @@ def flash_attention(
     block_k: int = BLOCK_K,
 ) -> jnp.ndarray:
     """Drop-in twin of attention.dot_product_attention (BTHD layout)."""
-    if scale is None:
-        scale = 1.0 / np.sqrt(q.shape[-1])
-    b, t, h, d = q.shape
-    bq = min(block_q, t)
-    bk = min(block_k, t)
-    pad = (-t) % max(bq, bk)
-    qf, kf, vf = (_bhtd(x) for x in (q, k, v))
-    if pad:
-        qf, kf, vf = (
-            jnp.pad(x, ((0, 0), (0, pad), (0, 0))) for x in (qf, kf, vf)
-        )
-    out = _flash(qf, kf, vf, causal, float(scale), bq, bk, t)
-    return (
-        out[:, :t]
-        .reshape(b, h, t, d)
-        .transpose(0, 2, 1, 3)
-        .astype(q.dtype)
+    out, _ = flash_attention_lse(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
     )
+    return out

@@ -90,6 +90,66 @@ def _local_ring(q, k, v, *, axis_name, causal, scale):
     return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # [B, Tq, H, D]
 
 
+def _flash_ring_body(i, carry, *, axis_name, scale, causal):
+    """One ring step with the FLASH KERNEL as the inner block: the kernel's
+    lse output lets normalized block results merge exactly —
+    ``o = o*exp(lse_o - lse_new) + o_blk*exp(lse_blk - lse_new)``."""
+    from znicz_tpu.ops.pallas.attention import flash_attention_lse
+
+    o, lse, k_blk, v_blk, q, my_idx = carry
+    n = jax.lax.psum(1, axis_name)
+    src = (my_idx - i) % n
+
+    def full_block(_):  # src < my: every key is in the past — no mask
+        return flash_attention_lse(q, k_blk, v_blk, causal=False, scale=scale)
+
+    def diag_block(_):  # src == my: local causal == global causal
+        return flash_attention_lse(q, k_blk, v_blk, causal=True, scale=scale)
+
+    def skip_block(_):  # src > my under causal: zero mass, and the switch
+        # means the kernel never runs — the ring-level causal compute skip
+        return jnp.zeros_like(o), jnp.full_like(lse, -1e30)
+
+    if causal:
+        branch = jnp.where(src < my_idx, 0, jnp.where(src == my_idx, 1, 2))
+        o_blk, lse_blk = jax.lax.switch(
+            branch, (full_block, diag_block, skip_block), None
+        )
+    else:
+        o_blk, lse_blk = full_block(None)
+    o_blk = o_blk.astype(jnp.float32)
+
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+    o = o * w_old + o_blk * w_blk
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+    v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    return (o, lse_new, k_blk, v_blk, q, my_idx)
+
+
+def _local_ring_flash(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body with flash-kernel inner blocks [B, T_local, H, D]."""
+    b, t_local, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    def varying(x):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    o = varying(jnp.zeros((b, t_local, h, d), jnp.float32))
+    lse = varying(jnp.full((b, t_local, h), -jnp.inf, jnp.float32))
+    body = partial(
+        _flash_ring_body, axis_name=axis_name, scale=scale, causal=causal
+    )
+    o, _, _, _, _, _ = jax.lax.fori_loop(
+        0, n, body, (o, lse, k, v, q, my_idx)
+    )
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -99,19 +159,28 @@ def ring_attention(
     axis: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    inner: str = "dense",  # "dense" (jnp blocks) | "flash" (pallas kernel)
 ) -> jnp.ndarray:
     """Attention with the sequence axis sharded over ``mesh[axis]``.
 
     ``q/k/v``: [B, T, H, D] global arrays (T divisible by the axis size).
-    Returns [B, T, H, D] with the same sharding.
+    Returns [B, T, H, D] with the same sharding.  ``inner="flash"`` runs
+    each per-shard block through the Pallas flash kernel (kernel-speed SP
+    long context); the diagonal ring step reuses the kernel's causal path,
+    fully-future blocks are skipped entirely via ``lax.switch``.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    if inner not in ("dense", "flash"):
+        raise ValueError(f"inner={inner!r}: want 'dense' or 'flash'")
+    local = _local_ring_flash if inner == "flash" else _local_ring
     spec = P(None, axis, None, None)
     fn = jax.shard_map(
-        partial(_local_ring, axis_name=axis, causal=causal, scale=scale),
+        partial(local, axis_name=axis, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # the pallas_call's out_shape carries no varying-axes annotation
+        check_vma=inner != "flash",
     )
     return fn(q, k, v)

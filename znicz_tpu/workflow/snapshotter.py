@@ -174,6 +174,8 @@ class Snapshotter:
             self._kept.append(path)
             while len(self._kept) > self.keep:
                 old = self._kept.pop(0)
-                if os.path.exists(old):
+                # only the writer touches the filesystem (multi-host
+                # processes share bookkeeping but must not race on removes)
+                if self.writer and os.path.exists(old):
                     os.remove(old)
         return path

@@ -318,38 +318,57 @@ class TransformerLMWorkflow(Workflow):
     def _batch_target(self, mb):
         return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
 
+    def _sharded_flash(self):
+        """Flash kernel under DataParallel: a pallas_call has no GSPMD
+        partitioning rule, but batch-heads are embarrassingly parallel — a
+        ``shard_map`` over the data (and, under TP, model/head) axis runs
+        the kernel per-shard and composes with the GSPMD-sharded step."""
+        from jax.sharding import PartitionSpec as P
+
+        from znicz_tpu.ops.pallas.attention import flash_attention
+        from znicz_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = self.parallel.mesh
+        shard_heads = (
+            self.tensor_parallel and mesh.shape.get(MODEL_AXIS, 1) > 1
+        )
+        spec = P(DATA_AXIS, None, MODEL_AXIS if shard_heads else None, None)
+
+        def fn(q, k, v, *, causal=False, scale=None):
+            return jax.shard_map(
+                partial(flash_attention, causal=causal, scale=scale),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,  # pallas out_shape carries no vma info
+            )(q, k, v)
+
+        return fn
+
     def _attention_fn(self):
+        on_tpu = jax.default_backend() in ("tpu", "axon")
         if self.sequence_parallel:
-            if self.attention == "flash":
-                raise ValueError(
-                    "attention='flash' cannot combine with "
-                    "sequence_parallel=True: ring attention owns the "
-                    "sequence axis (its per-shard blocks are computed "
-                    "in-loop, not by the flash kernel)"
-                )
             from znicz_tpu.parallel.ring_attention import ring_attention
 
-            return partial(ring_attention, mesh=self.mesh)
+            # ring attention owns the sequence axis; its per-shard inner
+            # blocks run the flash kernel when requested (or on TPU by
+            # default), so SP long context runs at kernel speed
+            inner = (
+                "flash"
+                if self.attention == "flash"
+                or (self.attention == "auto" and on_tpu
+                    and self.max_seq >= 512)  # same gate as non-SP auto
+                else "dense"
+            )
+            return partial(ring_attention, mesh=self.mesh, inner=inner)
         # blockwise flash kernel (ops/pallas/attention.py): O(T·D) memory
         # and VMEM-resident online softmax — the long-context default on
-        # TPU once the quadratic score matrix stops being a rounding error.
-        # Under DataParallel the jitted step is GSPMD-sharded and a
-        # pallas_call has no partitioning rule, so auto never picks flash
-        # there and an explicit request is rejected up front (pipeline
-        # parallel is fine — its shard_map runs per-device code).
-        if self.attention == "flash" and self.parallel is not None:
-            raise ValueError(
-                "attention='flash' cannot run inside a DataParallel-"
-                "sharded step (no GSPMD partitioning rule for the pallas "
-                "kernel); use sequence_parallel ring attention to scale "
-                "attention over devices"
-            )
+        # TPU once the quadratic score matrix stops being a rounding error
         if self.attention == "flash" or (
-            self.attention == "auto"
-            and self.parallel is None
-            and jax.default_backend() in ("tpu", "axon")
-            and self.max_seq >= 512
+            self.attention == "auto" and on_tpu and self.max_seq >= 512
         ):
+            if self.parallel is not None:
+                return self._sharded_flash()
             from znicz_tpu.ops.pallas.attention import flash_attention
 
             return flash_attention

@@ -287,12 +287,17 @@ class Workflow(Logger):
         else:
             self._eval_conf_step = None
 
-    def _acc_init(self) -> jax.Array:
-        """Fresh epoch accumulator (plain transfer — no compile); placed
-        replicated over the mesh so multi-host steps see one global array."""
+    def _put_replicated(self, arr):
+        """Host array -> device, replicated over the mesh when a placement
+        policy exists (multi-host jitted steps need every non-sharded input
+        placed as ONE global array, not a per-process local one)."""
         if self.parallel is not None:
-            return self.parallel.put_replicated(self._acc_init_host.copy())
-        return jax.device_put(self._acc_init_host.copy())
+            return self.parallel.put_replicated(arr)
+        return jax.device_put(arr)
+
+    def _acc_init(self) -> jax.Array:
+        """Fresh epoch accumulator (plain transfer — no compile)."""
+        return self._put_replicated(self._acc_init_host.copy())
 
     # ------------------------------------------------------------------
     def _create_initial_state(self) -> TrainState:
@@ -363,12 +368,9 @@ class Workflow(Logger):
         # loader-owned device context (e.g. HBM-resident dataset pool):
         # ONE up-front transfer, threaded through every step as an argument
         ctx_host = self.loader.device_context()
-        put_ctx = (
-            self.parallel.put_replicated
-            if self.parallel is not None
-            else jax.device_put
+        self._ctx = (
+            None if ctx_host is None else self._put_replicated(ctx_host)
         )
-        self._ctx = None if ctx_host is None else put_ctx(ctx_host)
         self._build_steps()
 
     def _batch_target(self, mb):
@@ -451,11 +453,7 @@ class Workflow(Logger):
                         ],
                         np.float32,
                     )
-                    lrs = (
-                        self.parallel.put_replicated(lrs_host)
-                        if self.parallel is not None
-                        else jnp.asarray(lrs_host)
-                    )
+                    lrs = self._put_replicated(lrs_host)
                     self.state, acc = self._train_epoch_scan(
                         self.state, xs, ys, masks, lrs,
                         self._acc_init(), self._ctx,
@@ -590,12 +588,7 @@ class Workflow(Logger):
             if use_conf:
                 if conf is None:
                     nc = int(np.prod(self.model.output_shape))
-                    conf_host = np.zeros((nc, nc), np.int32)
-                    conf = (
-                        self.parallel.put_replicated(conf_host)
-                        if self.parallel is not None
-                        else jax.device_put(conf_host)
-                    )
+                    conf = self._put_replicated(np.zeros((nc, nc), np.int32))
                 acc, conf = self._eval_conf_step(
                     self.state.params, x, y, mask, acc, conf, self._ctx
                 )
