@@ -182,6 +182,22 @@ class TestRingFlashInner:
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
 
+    def test_bf16_inputs_causal(self):
+        # the causal lax.switch branches must agree on dtype (skip branch
+        # emits f32 zeros) — regression for a trace-time TypeError
+        mesh = make_mesh(8, 1)
+        q, k, v = (
+            x.astype(jnp.bfloat16)
+            for x in _qkv(b=1, t=64, h=2, d=8, seed=19)
+        )
+        out = ring_attention(q, k, v, mesh=mesh, causal=True, inner="flash")
+        assert out.dtype == jnp.bfloat16
+        ref = ring_attention(q, k, v, mesh=mesh, causal=True, inner="dense")
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=5e-2, atol=5e-2,
+        )
+
     def test_bad_inner_rejected(self):
         mesh = make_mesh(8, 1)
         q, k, v = _qkv(b=1, t=16, h=1, d=8)

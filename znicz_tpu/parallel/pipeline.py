@@ -58,7 +58,9 @@ def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
     return (n_stages - 1) / (n_stages - 1 + m_pad)
 
 
-def _local_pipeline(params, x, *, apply_one, axis_name, n_micro, n_stages):
+def _local_pipeline(
+    params, x, *, apply_one, axis_name, n_micro, n_stages, vary_axes=None
+):
     """shard_map body: params [1, ...] (this device's stage), x [C, mb, F]
     (this device's CHUNK of the microbatch store, C = M'/S); returns this
     device's chunk of finished microbatches [C, mb, F].
@@ -83,10 +85,13 @@ def _local_pipeline(params, x, *, apply_one, axis_name, n_micro, n_stages):
     fwd = [(j, (j + 1) % n_stages) for j in range(n_stages)]
     bwd = [(j, (j - 1) % n_stages) for j in range(n_stages)]
 
-    # fresh constants are unvarying: pcast buf to varying before it mixes
-    # with stage-dependent values; zeros_like(x) inherits varying from x
+    # fresh constants are unvarying: pcast buf to varying over EVERY manual
+    # axis (pipe, and data when composing with DP) before it mixes with
+    # device-dependent values; zeros_like(x) inherits varying from x
     buf0 = jax.lax.pcast(
-        jnp.zeros(x.shape[1:], x.dtype), axis_name, to="varying"
+        jnp.zeros(x.shape[1:], x.dtype),
+        vary_axes or axis_name,
+        to="varying",
     )
     is_last = s_idx == n_stages - 1
 
@@ -139,6 +144,7 @@ def pipeline_apply(
     mesh: Mesh,
     n_microbatches: int,
     axis: str = PIPE_AXIS,
+    data_axis: str = None,
     check_vma: bool = True,
 ) -> jnp.ndarray:
     """Run x [B, F] through the stacked stages, pipelined over ``mesh[axis]``.
@@ -147,8 +153,22 @@ def pipeline_apply(
     B must divide by ``n_microbatches``.  Set ``check_vma=False`` only when
     ``apply_one`` contains pallas_calls (their out_shapes carry no
     varying-mesh-axes annotation) — it disables shard_map's safety check.
+
+    ``data_axis``: compose with data parallelism — the per-microbatch row
+    dim shards over that mesh axis, so each data replica runs its own
+    pipeline over its batch shard (stage params replicate across ``data``;
+    shard_map's transpose psums their grads over it automatically).  Real
+    pipelines ride a (data, pipe) mesh — GPipe without DP is a demo.
     """
     n_stages = mesh.shape[axis]
+    if data_axis is not None:
+        n_data = mesh.shape[data_axis]
+        mb = x.shape[0] // n_microbatches
+        if mb % n_data:
+            raise ValueError(
+                f"microbatch rows {mb} not divisible by data axis "
+                f"{n_data} (batch {x.shape[0]}, M={n_microbatches})"
+            )
     stage_dims = {
         leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)
     }
@@ -177,6 +197,10 @@ def pipeline_apply(
         return P(axis, *([None] * (leaf.ndim - 1)))
 
     param_specs = jax.tree_util.tree_map(spec_for, stacked_params)
+    # microbatch STORE sharded chunk-per-device over pipe; under DP the
+    # row dim additionally shards over data (independent pipeline per
+    # data replica)
+    store_spec = P(axis, data_axis)
     fn = jax.shard_map(
         partial(
             _local_pipeline,
@@ -184,11 +208,11 @@ def pipeline_apply(
             axis_name=axis,
             n_micro=n_microbatches,
             n_stages=n_stages,
+            vary_axes=(axis,) + ((data_axis,) if data_axis else ()),
         ),
         mesh=mesh,
-        # stages sharded; microbatch STORE sharded chunk-per-device
-        in_specs=(param_specs, P(axis)),
-        out_specs=P(axis),
+        in_specs=(param_specs, store_spec),
+        out_specs=store_spec,
         check_vma=check_vma,
     )
     out = fn(stacked_params, micro)[:n_microbatches]
@@ -205,6 +229,7 @@ def pipelined_model_apply(
     mesh: Mesh,
     n_microbatches: int,
     axis: str = PIPE_AXIS,
+    data_axis: str = None,
     check_vma: bool = True,
 ) -> jnp.ndarray:
     """Embed -> pipelined tower -> head: the real-model decomposition
@@ -215,7 +240,8 @@ def pipelined_model_apply(
     h = pipeline_apply(
         params["stages"], h,
         apply_one=stage_fn, mesh=mesh,
-        n_microbatches=n_microbatches, axis=axis, check_vma=check_vma,
+        n_microbatches=n_microbatches, axis=axis, data_axis=data_axis,
+        check_vma=check_vma,
     )
     return head_fn(params["head"], h)
 

@@ -101,10 +101,16 @@ def _flash_ring_body(i, carry, *, axis_name, scale, causal):
     src = (my_idx - i) % n
 
     def full_block(_):  # src < my: every key is in the past — no mask
-        return flash_attention_lse(q, k_blk, v_blk, causal=False, scale=scale)
+        out, l = flash_attention_lse(
+            q, k_blk, v_blk, causal=False, scale=scale
+        )
+        return out.astype(jnp.float32), l  # f32 like skip_block's zeros
 
     def diag_block(_):  # src == my: local causal == global causal
-        return flash_attention_lse(q, k_blk, v_blk, causal=True, scale=scale)
+        out, l = flash_attention_lse(
+            q, k_blk, v_blk, causal=True, scale=scale
+        )
+        return out.astype(jnp.float32), l
 
     def skip_block(_):  # src > my under causal: zero mass, and the switch
         # means the kernel never runs — the ring-level causal compute skip
@@ -117,7 +123,6 @@ def _flash_ring_body(i, carry, *, axis_name, scale, causal):
         )
     else:
         o_blk, lse_blk = full_block(None)
-    o_blk = o_blk.astype(jnp.float32)
 
     lse_new = jnp.logaddexp(lse, lse_blk)
     w_old = jnp.exp(lse - lse_new)[..., None]

@@ -134,10 +134,12 @@ def stack_lm_blocks(params, n_stages: int):
 
 
 def lm_apply_pipelined(
-    params_pp, tokens, *, n_heads, mesh, n_microbatches, attention_fn=None
+    params_pp, tokens, *, n_heads, mesh, n_microbatches,
+    data_axis=None, attention_fn=None,
 ):
     """tokens [B, T] -> logits, with the block tower pipelined over the
-    mesh's ``pipe`` axis (embed/head run outside the shard_map)."""
+    mesh's ``pipe`` axis (embed/head run outside the shard_map);
+    ``data_axis`` shards microbatch rows for DPxPP composition."""
     from znicz_tpu.parallel.pipeline import pipelined_model_apply
 
     def embed_fn(p, tok):
@@ -156,11 +158,23 @@ def lm_apply_pipelined(
     return pipelined_model_apply(
         params_pp, tokens,
         embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
-        mesh=mesh, n_microbatches=n_microbatches,
+        mesh=mesh, n_microbatches=n_microbatches, data_axis=data_axis,
         # flash attention inside the stage is a pallas_call: no vma
         # annotation on its out_shapes, so the check must be off for it
         check_vma=attention_fn is None,
     )
+
+
+def lm_pp_rules(path: str, leaf):
+    """DataParallel param_rules for the pipelined LM: stacked stage params
+    shard over ``pipe`` (chunk-per-device), embed/head replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    from znicz_tpu.parallel.mesh import PIPE_AXIS
+
+    if "'stages'" in path:
+        return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
+    return P()
 
 
 def lm_tp_rules(path: str, leaf):
@@ -200,10 +214,14 @@ class TransformerLMWorkflow(Workflow):
     > 1 and n_heads divisible by it.
     ``pipeline_parallel``: pipeline the block tower over the mesh's
     ``pipe`` axis (GPipe microbatching, ``parallel/pipeline.py``); pass a
-    ``mesh`` with a pipe axis whose size divides ``n_layers``.  Stage
-    params live chunk-per-device; embed/head run outside the pipeline.
-    Mutually exclusive with sequence/tensor parallel (one mesh axis per
-    workflow for now).
+    ``mesh`` with a pipe axis whose size divides ``n_layers``, or compose
+    with data parallelism by passing ``parallel=DataParallel(mesh)`` over
+    a (data, pipe) mesh — each data replica runs its own pipeline on its
+    batch shard and stage grads all-reduce over ``data``.  Stage params
+    live chunk-per-device; embed/head run outside the pipeline.
+    ``pipeline_microbatches`` defaults to ``6 * n_stages`` (GPipe bubble
+    < 0.15 for every stage count).  Mutually exclusive with
+    sequence/tensor parallel.
     """
 
     def __init__(
@@ -269,14 +287,24 @@ class TransformerLMWorkflow(Workflow):
                     "sequence/tensor parallel (one mesh axis per workflow)"
                 )
             if parallel is not None:
-                raise ValueError(
-                    "pipeline_parallel=True cannot combine with "
-                    "parallel=DataParallel(...): the batch placement would "
-                    "ride a different mesh than the pipe shard_map"
-                )
+                # DPxPP: batch over data, stages over pipe, on ONE mesh —
+                # the placement policy's mesh is the pipeline's mesh
+                if mesh is not None and mesh is not parallel.mesh:
+                    raise ValueError(
+                        "pipeline_parallel with parallel=DataParallel: "
+                        "pass the (data, pipe) mesh via the DataParallel "
+                        "(mesh= must be omitted or identical)"
+                    )
+                mesh = self.mesh = parallel.mesh
+                from znicz_tpu.parallel import DataParallel
+
+                if self.parallel.param_rules is None:
+                    self.parallel = DataParallel(
+                        parallel.mesh, param_rules=lm_pp_rules
+                    )
             if mesh is None or PIPE_AXIS not in mesh.shape:
                 raise ValueError(
-                    "pipeline_parallel=True needs mesh= with a 'pipe' axis"
+                    "pipeline_parallel=True needs a mesh with a 'pipe' axis"
                 )
             self._n_stages = mesh.shape[PIPE_AXIS]
             if n_layers % self._n_stages:
@@ -284,8 +312,11 @@ class TransformerLMWorkflow(Workflow):
                     f"n_layers={n_layers} not divisible by pipe axis "
                     f"{self._n_stages}"
                 )
+            # 6 microbatches per stage bounds the GPipe bubble
+            # (S-1)/(S-1+M) under 1/7 ~ 0.143 for EVERY stage count —
+            # S alone (the old default) cooks in up to 43%
             self.pipeline_microbatches = (
-                pipeline_microbatches or self._n_stages
+                pipeline_microbatches or 6 * self._n_stages
             )
         if tensor_parallel:
             from znicz_tpu.parallel import DataParallel
@@ -367,7 +398,10 @@ class TransformerLMWorkflow(Workflow):
         if self.attention == "flash" or (
             self.attention == "auto" and on_tpu and self.max_seq >= 512
         ):
-            if self.parallel is not None:
+            # under PP the kernel already runs inside the pipe/data
+            # shard_map (per-device code) — only the GSPMD-sharded
+            # non-pipelined step needs the explicit wrapper
+            if self.parallel is not None and not self.pipeline_parallel:
                 return self._sharded_flash()
             from znicz_tpu.ops.pallas.attention import flash_attention
 
@@ -379,11 +413,14 @@ class TransformerLMWorkflow(Workflow):
         attention_fn = self._attention_fn()
 
         if self.pipeline_parallel:
+            from znicz_tpu.parallel.mesh import DATA_AXIS
+
             apply_fn = partial(
                 lm_apply_pipelined,
                 n_heads=n_heads,
                 mesh=self.mesh,
                 n_microbatches=self.pipeline_microbatches,
+                data_axis=DATA_AXIS if self.parallel is not None else None,
                 attention_fn=attention_fn,
             )
         else:
@@ -459,12 +496,14 @@ class TransformerLMWorkflow(Workflow):
             rand_name=self.rand_name,
         )
         if self.pipeline_parallel:
-            from znicz_tpu.parallel.pipeline import shard_stacked_params
-
             params = stack_lm_blocks(params, self._n_stages)
-            # stage params chunk-per-device up front; embed/head stay
-            # replicated (GSPMD propagates through the update)
-            params["stages"] = shard_stacked_params(
-                params["stages"], self.mesh
-            )
+            if self.parallel is None:
+                from znicz_tpu.parallel.pipeline import shard_stacked_params
+
+                # stage params chunk-per-device up front; embed/head stay
+                # replicated (GSPMD propagates through the update); with a
+                # placement policy, shard_state's lm_pp_rules do this
+                params["stages"] = shard_stacked_params(
+                    params["stages"], self.mesh
+                )
         return TrainState.create(params, prng.get("workflow").key())
