@@ -143,6 +143,81 @@ class TestMoE:
         assert cap16 < 1.6 * cap4, (cap4, cap16)
         assert dense16 > 2.5 * dense4, (dense4, dense16)  # the contrast
 
+    def test_capacity_e64_memory_stays_off_the_bec_wall(self):
+        # VERDICT r2 weak #3: the one-hot formulation materialized [B,E,C]
+        # tensors.  The sort/segment dispatch must compile WITHOUT any
+        # B*E*C-sized intermediate at E=64 — checked against the compiled
+        # HLO's buffer shapes, and numerics must still match dense when
+        # capacity is ample.
+        b, e, f, h, k = 512, 64, 32, 64, 2
+        prng.seed_all(21)
+        params = moe.init_params(f, h, e)
+        x = jax.random.normal(jax.random.key(8), (b, f))
+        fn = jax.jit(
+            lambda p, x: moe.apply(
+                p, x, top_k=k, dispatch="capacity", capacity_factor=1.25
+            )
+        )
+        compiled = fn.lower(params, x).compile()
+        cap = moe.expert_capacity(b, e, k, 1.25)
+        bec = b * e * cap  # 1.3M elements at this size; 4*10^9 at scale
+        import re
+
+        hlo = compiled.as_text()
+        big = [
+            shape
+            for shape in re.findall(r"f32\[([\d,]+)\]", hlo)
+            if np.prod([int(d) for d in shape.split(",")]) >= bec
+        ]
+        assert not big, f"B*E*C-scale buffers in HLO: {set(big)}"
+        # and the math is right: ample capacity == dense
+        ample = moe.apply(
+            params, x, top_k=k, dispatch="capacity", capacity_factor=float(e)
+        )
+        dense = moe.apply(params, x, top_k=k, dispatch="dense")
+        np.testing.assert_allclose(
+            np.asarray(ample), np.asarray(dense), rtol=2e-5, atol=1e-5
+        )
+
+    def test_capacity_grads_match_dense_when_ample(self):
+        # the scatter/gather dispatch must be differentiable along the
+        # same paths as the einsum form (gates, dispatched x, expert outs)
+        params = self._params(e=8, f=8, h=16, seed=17)
+        x = jax.random.normal(jax.random.key(10), (24, 8))
+
+        def loss(dispatch):
+            return lambda p, x: jnp.sum(
+                jnp.square(
+                    moe.apply(
+                        p, x, top_k=2, dispatch=dispatch,
+                        capacity_factor=8.0,
+                    )
+                )
+            )
+
+        gd = jax.grad(loss("dense"), argnums=(0, 1))(params, x)
+        gc = jax.grad(loss("capacity"), argnums=(0, 1))(params, x)
+        for a, b in zip(jax.tree_util.tree_leaves(gd),
+                        jax.tree_util.tree_leaves(gc)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
+
+    def test_capacity_topk_ge_experts_warns_dense_fallback(self):
+        import warnings
+
+        params = self._params(e=4, f=8, h=16, seed=15)
+        x = jax.random.normal(jax.random.key(9), (8, 8))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = moe.apply(params, x, top_k=4, dispatch="capacity")
+        assert any("degrades to the dense path" in str(x.message) for x in w)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(moe.apply(params, x, top_k=4, dispatch="dense")),
+            rtol=1e-6,
+        )
+
     def test_expert_parallel_capacity_sharded_matches_replicated(self):
         # E=16 sharded 4-way on the model axis == replicated (VERDICT #9)
         mesh = make_mesh(2, 4)

@@ -275,6 +275,18 @@ class TestPallasRBM:
             "hbias": jnp.full((h,), 20.0),
         }
 
+    def test_oversized_problem_rejected_up_front(self):
+        # no silent Mosaic compile failure: the VMEM budget is checked
+        # before any kernel is built
+        params = {
+            "weights": jnp.zeros((2048, 2048), jnp.float32),
+            "vbias": jnp.zeros((2048,)),
+            "hbias": jnp.zeros((2048,)),
+        }
+        v0 = jnp.zeros((1024, 2048))
+        with pytest.raises(ValueError, match="VMEM budget"):
+            pallas_rbm.cd_step(params, v0, 0, learning_rate=0.1)
+
     def test_saturated_matches_twin_exactly(self):
         params = self._saturated_params()
         v0 = (

@@ -188,9 +188,9 @@ class TestTransformerWorkflow:
         from znicz_tpu.parallel.pipeline import bubble_fraction
 
         tokens = np.asarray(
-            np.random.default_rng(6).integers(0, 16, (32, 16)), np.int32
+            np.random.default_rng(6).integers(0, 16, (48, 16)), np.int32
         )
-        ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=32)
+        ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=24)
         wf = TransformerLMWorkflow(
             ld, vocab=16, d_model=32, n_layers=4, n_heads=2,
             pipeline_parallel=True, mesh=make_mesh(1, 1, 4),
@@ -200,6 +200,14 @@ class TestTransformerWorkflow:
         # the default holds the bound for EVERY stage count
         for s in (2, 4, 8, 16, 64):
             assert bubble_fraction(s, 6 * s) <= 0.16
+        # ... and clamps to a batch divisor instead of crashing configs
+        # whose minibatch doesn't divide 6S (here 32 -> 16)
+        ld2 = FullBatchLoader({"train": tokens.copy()}, minibatch_size=32)
+        wf2 = TransformerLMWorkflow(
+            ld2, vocab=16, d_model=32, n_layers=4, n_heads=2,
+            pipeline_parallel=True, mesh=make_mesh(1, 1, 4),
+        )
+        assert wf2.pipeline_microbatches == 16
 
     def test_sequence_parallel_flash_inner_matches_dense(self):
         # SP long context at kernel speed: ring(inner=flash) trains to the

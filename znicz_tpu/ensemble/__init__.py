@@ -184,10 +184,19 @@ def train_from_module(
             with open(r["params_path"], "rb") as f:
                 member_params.append(pickle.load(f))
     # build the aggregation scaffold in-process (dry run: model + loader,
-    # no training) and graft each member's trained params onto views of it
+    # no training) and graft each member's trained params onto views of it.
+    # Honor the caller's device choice only while it can still take effect:
+    # a jax_platforms update on an already-initialized parent backend is at
+    # best a no-op (the spawned workers above always honored it)
+    from jax._src import xla_bridge
+
+    scaffold_device = (
+        device if not xla_bridge.backends_are_initialized() else None
+    )
     launcher, _ = _run_workflow_module(
         workflow_path, config_path,
-        seed=base_seed, stop_after=stop_after, device=device, dry_run=True,
+        seed=base_seed, stop_after=stop_after, device=scaffold_device,
+        dry_run=True,
     )
     wf = launcher.workflow
 

@@ -122,12 +122,19 @@ class ImageDirectoryLoader(Loader):
             )
         else:
             # fit dataset statistics on a deterministic sample of the
-            # training split (first N in sorted order — no PRNG draw, so
-            # the reproducibility stream stays untouched)
+            # training split — STRIDED across the (class-major) sorted
+            # index so the sample spans classes instead of exhausting the
+            # first one(s); no PRNG draw, so the reproducibility stream
+            # stays untouched
             split = "train" if "train" in self.index else next(
                 iter(self.index)
             )
-            entries = self.index[split][:normalization_fit_samples]
+            all_entries = self.index[split]
+            n_fit = min(normalization_fit_samples, len(all_entries))
+            pick = np.linspace(
+                0, len(all_entries) - 1, n_fit
+            ).astype(int)  # spans the whole split in every regime
+            entries = [all_entries[i] for i in pick]
             h, w, c = self.target_shape
             sample = np.stack(
                 [

@@ -13,13 +13,14 @@ MXU utilization beats saved FLOPs at these sizes).
 
 ``dispatch="capacity"`` is the mode that scales to many experts:
 GShard-style capacity-bounded dispatch.  Each expert processes at most
-``C = ceil(k*B/E * capacity_factor)`` tokens; routing builds one-hot
-dispatch/combine tensors [B, E, C] (dense masks, not scatters —
-TPU-friendly) and the expert matmuls run on the dispatched [E, C, F]
-block, so expert FLOPs are ``k*B*capacity_factor*F*H`` — independent of E.
-Tokens over capacity are dropped (output 0; the residual layer wrapper
-passes them through unchanged — standard token-drop accounting).  Slot
-priority is (choice rank, token index), so results are deterministic.
+``C = ceil(k*B/E * capacity_factor)`` tokens; routing stably sorts the
+(token, choice) pairs by expert and scatter/gathers into the [E, C, F]
+dispatch block, so expert FLOPs are ``k*B*capacity_factor*F*H`` and the
+routing working set is O(B*k*F + E*C*F) — both independent of E (no
+[B, E, C] one-hot tensors).  Tokens over capacity are dropped (output 0;
+the residual layer wrapper passes them through unchanged — standard
+token-drop accounting).  Slot priority is (choice rank, token index), so
+results are deterministic.
 """
 
 from __future__ import annotations
@@ -94,6 +95,15 @@ def apply(
         )
     if dispatch not in ("dense", "capacity"):
         raise ValueError(f"unknown dispatch mode {dispatch!r}")
+    if dispatch == "capacity":  # top_k >= e: capacity has no meaning
+        import warnings
+
+        warnings.warn(
+            f"dispatch='capacity' with top_k={top_k} >= n_experts={e} "
+            "degrades to the dense path (full softmax gates, no token "
+            "drop); lower top_k for capacity semantics",
+            stacklevel=2,
+        )
     if top_k >= e:
         gates = jax.nn.softmax(logits, axis=-1)
     else:
@@ -123,25 +133,40 @@ def expert_capacity(
 
 
 def _capacity_apply(params, x, logits, *, top_k, capacity_factor):
+    """Sort/segment dispatch: working set O(B*k*F + E*C*F).
+
+    No ``[B, E, C]`` one-hot tensors (at B=4096, E=64, cf=1.25 those are
+    ~10^9 elements EACH — a memory wall exactly where capacity mode is
+    supposed to take over).  Instead the (token, choice) pairs are stably
+    sorted by expert; position-within-expert comes from a searchsorted
+    against the segment starts, and dispatch/combine are a unique-slot
+    scatter-add / gather.  Routing priority is (choice rank, token index),
+    identical to the one-hot formulation: the flat order is choice-major
+    and the sort is stable.  Gradients flow through gates, dispatched
+    activations and expert outputs — the same differentiable paths as the
+    einsum form (routing indices are non-differentiable in both)."""
     b, e = logits.shape
+    f = x.shape[1]
+    kb = top_k * b
     cap = expert_capacity(b, e, top_k, capacity_factor)
     top_vals, top_idx = jax.lax.top_k(logits, top_k)  # [B, k]
     g = jax.nn.softmax(top_vals, axis=-1)  # [B, k]
-    onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [B, k, E]
-    # slot position inside each expert's capacity buffer, priority
-    # (choice rank, token index): flatten slot-major and cumsum per expert
-    flat = onehot.transpose(1, 0, 2).reshape(top_k * b, e)
-    pos_flat = jnp.cumsum(flat, axis=0) - flat  # [k*B, E]
-    pos = jnp.sum(pos_flat * flat, axis=-1).astype(jnp.int32)  # [k*B]
-    pos = pos.reshape(top_k, b).T  # [B, k] position in its expert
-    keep = (pos < cap).astype(jnp.float32)  # token-drop accounting
-    poshot = jax.nn.one_hot(pos, cap, dtype=jnp.float32) * keep[..., None]
-    dispatch = jnp.einsum("bke,bkc->bec", onehot, poshot)  # [B, E, C]
-    combine = jnp.einsum("bk,bke,bkc->bec", g, onehot, poshot)
-    xe = jnp.einsum(
-        "bec,bf->ecf", dispatch.astype(x.dtype), x,
-        preferred_element_type=jnp.float32,
-    )  # [E, C, F]
+    # flatten choice-major (flat index = rank*B + token) so the stable
+    # sort preserves (choice rank, token index) slot priority
+    eid = top_idx.T.reshape(-1)  # [kB] expert of each choice
+    tok = jnp.tile(jnp.arange(b, dtype=jnp.int32), top_k)  # [kB]
+    gate = g.T.reshape(-1)  # [kB]
+    order = jnp.argsort(eid, stable=True)
+    eid_s = eid[order]
+    # position inside the expert's capacity buffer = rank within segment
+    first = jnp.searchsorted(eid_s, eid_s, side="left")
+    pos_s = jnp.arange(kb, dtype=jnp.int32) - first.astype(jnp.int32)
+    # over-capacity choices route to a trailing drop slot (row e*cap):
+    # zero-initialized on dispatch, zero expert output on combine
+    dest_s = jnp.where(pos_s < cap, eid_s * cap + pos_s, e * cap)
+    xe = jnp.zeros((e * cap + 1, f), x.dtype)
+    xe = xe.at[dest_s].add(x[tok[order]])  # unique slots: add == set
+    xe = xe[:-1].reshape(e, cap, f)
     h = jnp.tanh(
         jnp.einsum(
             "ecf,efh->ech", xe, params["w1"],
@@ -152,7 +177,11 @@ def _capacity_apply(params, x, logits, *, top_k, capacity_factor):
     y = jnp.einsum(
         "ech,ehf->ecf", h, params["w2"], preferred_element_type=jnp.float32
     ) + params["b2"][:, None, :]
-    out = jnp.einsum("bec,ecf->bf", combine.astype(y.dtype), y)
+    y_flat = jnp.concatenate(
+        [y.reshape(e * cap, f), jnp.zeros((1, f), y.dtype)]
+    )
+    contrib = y_flat[dest_s] * gate[order].astype(y.dtype)[:, None]
+    out = jnp.zeros((b, f), y.dtype).at[tok[order]].add(contrib)
     return out.astype(x.dtype)
 
 

@@ -36,8 +36,9 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh
 
 # single-block kernel: everything resident in VMEM.  RBM-sized problems
-# (MNIST: 784x1024 weights, batches <= 1024) fit with room to spare; the
-# wrapper falls back to the jnp twin above this budget.
+# (MNIST: 784x1024 weights, batches <= 1024) fit with room to spare.
+# Above this budget cd_step raises up front (no silent Mosaic failure);
+# RBMWorkflow's impl="auto" checks fits_vmem and picks the jnp twin.
 VMEM_BUDGET_BYTES = 10 * 1024 * 1024
 
 
@@ -215,6 +216,17 @@ def cd_step(
     inside the kernel.  ``mesh``: treat v0/mask as sharded over
     ``mesh[data_axis]``; local statistics psum into the exact full-batch
     update (each shard gets a decorrelated seed)."""
+    b, v = v0.shape
+    h = params["hbias"].shape[0]
+    if mesh is not None:
+        b = -(-b // mesh.shape[data_axis])  # per-shard batch
+    if not fits_vmem(b, v, h):
+        raise ValueError(
+            f"RBM problem (batch={b}, visible={v}, hidden={h}) exceeds the "
+            f"single-block VMEM budget ({VMEM_BUDGET_BYTES >> 20} MiB); "
+            "use ops.rbm.cd_step (the jnp twin) or RBMWorkflow's "
+            "impl='auto'"
+        )
     if mask is None:
         mask = jnp.ones((v0.shape[0],), v0.dtype)
     if mesh is None:

@@ -289,7 +289,7 @@ class TransformerLMWorkflow(Workflow):
             if parallel is not None:
                 # DPxPP: batch over data, stages over pipe, on ONE mesh —
                 # the placement policy's mesh is the pipeline's mesh
-                if mesh is not None and mesh is not parallel.mesh:
+                if mesh is not None and mesh != parallel.mesh:
                     raise ValueError(
                         "pipeline_parallel with parallel=DataParallel: "
                         "pass the (data, pipe) mesh via the DataParallel "
@@ -314,10 +314,18 @@ class TransformerLMWorkflow(Workflow):
                 )
             # 6 microbatches per stage bounds the GPipe bubble
             # (S-1)/(S-1+M) under 1/7 ~ 0.143 for EVERY stage count —
-            # S alone (the old default) cooks in up to 43%
-            self.pipeline_microbatches = (
-                pipeline_microbatches or 6 * self._n_stages
-            )
+            # S alone cooks in up to 43%.  The default clamps to the
+            # largest batch divisor <= 6S so existing minibatch sizes keep
+            # working; an EXPLICIT microbatch count is validated strictly
+            # in pipeline_apply instead of silently adjusted.
+            if pipeline_microbatches:
+                self.pipeline_microbatches = pipeline_microbatches
+            else:
+                bs = loader.max_minibatch_size
+                m = min(6 * self._n_stages, bs)
+                while m > 1 and bs % m:
+                    m -= 1
+                self.pipeline_microbatches = m
         if tensor_parallel:
             from znicz_tpu.parallel import DataParallel
 
