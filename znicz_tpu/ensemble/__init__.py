@@ -188,11 +188,13 @@ def train_from_module(
     # Honor the caller's device choice only while it can still take effect:
     # a jax_platforms update on an already-initialized parent backend is at
     # best a no-op (the spawned workers above always honored it)
-    from jax._src import xla_bridge
+    try:
+        from jax._src.xla_bridge import backends_are_initialized
+    except ImportError:  # private API: assume initialized if it moves
+        def backends_are_initialized():
+            return True
 
-    scaffold_device = (
-        device if not xla_bridge.backends_are_initialized() else None
-    )
+    scaffold_device = device if not backends_are_initialized() else None
     launcher, _ = _run_workflow_module(
         workflow_path, config_path,
         seed=base_seed, stop_after=stop_after, device=scaffold_device,

@@ -29,6 +29,62 @@ from znicz_tpu.parallel.mesh import (
 )
 
 
+def cnn_tp_rules(model, n_model: int, *, tp_min_features: int = 1024):
+    """Channel-aware tensor-parallel placement for conv/FC models.
+
+    Megatron-style alternation over the model's weighted layers: a layer
+    whose output channels/features divide the ``model`` axis is COLUMN
+    sharded (conv ``[ky, kx, in, out]`` on ``out``, FC ``[in, out]`` on
+    ``out``, bias along); the NEXT weighted layer is ROW sharded on its
+    input dim, so XLA contracts locally and psums partial products —
+    conv kernels, the layers that dominate a CNN's FLOPs, stop
+    replicating.  FC layers additionally honor ``tp_min_features`` (the
+    size heuristic's threshold) so small heads stay replicated; conv
+    layers shard on divisibility alone (their FLOPs justify it at any
+    width).  Returns a ``param_rules`` callable for :class:`DataParallel`.
+    """
+    import re
+
+    from znicz_tpu.parallel.mesh import MODEL_AXIS as M
+
+    specs = {}
+    col_prev = False
+    for i, params in enumerate(model.params):
+        w = params.get("weights") if isinstance(params, dict) else None
+        if w is None or w.ndim < 2:
+            continue
+        is_conv = w.ndim == 4
+        out_dim = w.shape[-1]
+        in_dim = w.shape[-2] if is_conv else w.shape[0]
+        if col_prev and is_conv and in_dim % n_model == 0:
+            # row-parallel follower: shard the input/contraction dim.
+            # Conv only — an FC after a flatten sees the channel-sharded
+            # activations INTERLEAVED through its h*w*c input dim
+            # (channel-minor flatten), so contiguous dim-0 weight sharding
+            # would force a reshard instead of a local contract + psum
+            specs[(i, "weights")] = P(None, None, M, None)
+            specs[(i, "bias")] = P()
+            col_prev = False
+        elif out_dim % n_model == 0 and (
+            is_conv or out_dim >= tp_min_features
+        ):
+            specs[(i, "weights")] = P(*([None] * (w.ndim - 1)), M)
+            specs[(i, "bias")] = P(M)
+            col_prev = True
+        else:
+            col_prev = False
+
+    pat = re.compile(r"\[(\d+)\]\['(\w+)'\]")
+
+    def rules(path: str, leaf):
+        m = pat.search(path)
+        if not m:
+            return P()
+        return specs.get((int(m.group(1)), m.group(2)), P())
+
+    return rules
+
+
 class DataParallel:
     """Placement policy: how batches and params land on the mesh.
 

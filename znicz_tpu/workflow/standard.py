@@ -97,3 +97,24 @@ class StandardWorkflow(Workflow):
             epoch_dispatch=epoch_dispatch,
             name=name,
         )
+
+    def _default_param_rules(self):
+        """Conv models get channel-aware TP rules (Megatron col/row
+        alternation, ``parallel.cnn_tp_rules``) instead of the last-dim
+        size heuristic — conv kernels carry the FLOPs, so replicating
+        them wastes the model axis.  Pure-FC models keep the heuristic
+        (documented behavior; lm/pp workflows pass explicit rules)."""
+        if not any(
+            isinstance(p, dict)
+            and getattr(p.get("weights"), "ndim", 0) == 4
+            for p in self.model.params
+        ):
+            return None
+        from znicz_tpu.parallel.data_parallel import cnn_tp_rules
+        from znicz_tpu.parallel.mesh import MODEL_AXIS
+
+        return cnn_tp_rules(
+            self.model,
+            self.parallel.mesh.shape[MODEL_AXIS],
+            tp_min_features=self.parallel.tp_min_features,
+        )

@@ -211,13 +211,16 @@ class RBMWorkflow(Workflow):
         # fused CD-k kernel (hardware RNG, whole Gibbs chain in VMEM) when
         # on TPU and the problem fits the VMEM budget; the psum rule keeps
         # it available under a sharded batch (see ops/pallas/rbm.py)
+        # the kernel runs per data-axis SHARD, so the VMEM check uses the
+        # per-shard batch — a sharded big batch can still take the kernel
+        shard_batch = self.loader.max_minibatch_size
+        if self.parallel is not None:
+            shard_batch = -(-shard_batch // self.parallel.n_data)
         use_pallas = self.impl == "pallas" or (
             self.impl == "auto"
             and jax.default_backend() in ("tpu", "axon")
             and pallas_rbm.fits_vmem(
-                self.loader.max_minibatch_size,
-                self._n_visible,
-                self.n_hidden,
+                shard_batch, self._n_visible, self.n_hidden
             )
         )
         pallas_mesh = (

@@ -307,6 +307,12 @@ class Workflow(Logger):
             self.model.params, prng.get("workflow").key()
         )
 
+    def _default_param_rules(self):
+        """Template hook: model-aware TP placement rules used when the
+        placement policy has ``tp=True`` but no explicit ``param_rules``
+        (None keeps DataParallel's size heuristic)."""
+        return None
+
     def initialize(
         self,
         *,
@@ -333,6 +339,21 @@ class Workflow(Logger):
         elif self.state is None:
             self.state = self._create_initial_state()
         if self.parallel is not None:
+            rules = (
+                self._default_param_rules()
+                if self.parallel.tp and self.parallel.param_rules is None
+                else None
+            )
+            if rules is not None:
+                from znicz_tpu.parallel import DataParallel
+
+                # never mutate the caller's DataParallel (it may be shared)
+                self.parallel = DataParallel(
+                    self.parallel.mesh,
+                    tp=True,
+                    tp_min_features=self.parallel.tp_min_features,
+                    param_rules=rules,
+                )
             self.state = self.parallel.shard_state(self.state)
         # multi-host: every process runs this same loop; the loader serves
         # per-process sample shards, snapshot/services write on exactly one
