@@ -4,6 +4,7 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from znicz_tpu.core import prng
 from znicz_tpu.ensemble import Ensemble
@@ -58,6 +59,7 @@ class TestEnsemble:
         l1 = ens.workflows[1].loader.labels["train"]
         np.testing.assert_array_equal(l0, l1)
 
+    @pytest.mark.slow
     def test_train_from_module_concurrent_matches_serial(self, tmp_path):
         # process-level ensemble training (reference veles/ensemble mode):
         # deterministic given seeds, identical for every worker count

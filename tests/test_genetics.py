@@ -94,6 +94,7 @@ class TestConcurrentOptimize:
         assert dt < 8 * 0.2 * 0.8, dt  # faster than sequential => concurrent
         assert np.isfinite(result["best_fitness"])
 
+    @pytest.mark.slow
     def test_worker_processes_deterministic_and_worker_count_invariant(
         self, tmp_path
     ):
@@ -144,6 +145,28 @@ class TestConcurrentOptimize:
         assert np.isfinite(r2["best_fitness"])
         assert r2["best_fitness"] == r1["best_fitness"]
         assert r2["best_genome"] == r1["best_genome"]
+
+
+class TestSharedAcceleratorWarning:
+    def test_warns_when_workers_exceed_chips(self, monkeypatch):
+        import warnings
+
+        import jax
+
+        from znicz_tpu.core import subproc
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(jax, "device_count", lambda: 1)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            subproc.warn_if_shared_accelerator(4, None)
+        assert any("contend" in str(x.message) for x in w)
+        # device='cpu' is the documented recipe: no warning
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            subproc.warn_if_shared_accelerator(4, "cpu")
+            subproc.warn_if_shared_accelerator(1, None)
+        assert not w
 
 
 class TestOptimizeCLI:

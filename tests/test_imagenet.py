@@ -140,6 +140,76 @@ class TestImageNetLoader:
             out[0, 0, 0], [0.75, 0.5, 0.25], atol=1e-6
         )
 
+    def test_device_resident_matches_native_crops(self, packed_dir):
+        # the on-device crop+flip+normalize must produce EXACTLY what the
+        # native host path produces given the same PRNG draws
+        import jax
+
+        def batch(device_resident):
+            prng.seed_all(42)
+            loader = ImageNetLoader(
+                packed_dir, crop_size=27, minibatch_size=8,
+                device_resident=device_resident,
+            )
+            mb = next(iter(loader.batches("train", shuffle=False)))
+            pre = loader.device_preproc()
+            ctx_host = loader.device_context()
+            ctx = None if ctx_host is None else jax.device_put(ctx_host)
+            return np.asarray(pre(jnp.asarray(mb.data), ctx)), mb
+
+        host, mb_h = batch(False)
+        dev, mb_d = batch(True)
+        assert mb_d.data.shape == (8, 4)  # [B, (row, oy, ox, flip)] only
+        assert mb_d.data.dtype == np.int32
+        np.testing.assert_array_equal(mb_h.labels, mb_d.labels)
+        np.testing.assert_allclose(host, dev, atol=1e-6)
+
+    def test_device_resident_eval_center_crop(self, packed_dir):
+        import jax
+
+        prng.seed_all(7)
+        loader = ImageNetLoader(
+            packed_dir, crop_size=27, minibatch_size=8,
+            device_resident=True,
+        )
+        assert loader.epoch_scan_friendly
+        pre = loader.device_preproc()
+        ctx = jax.device_put(loader.device_context())
+        a = [
+            np.asarray(pre(jnp.asarray(mb.data), ctx))
+            for mb in loader.batches("valid", shuffle=False)
+        ]
+        prng.seed_all(99)  # eval crops must not depend on the PRNG
+        b = [
+            np.asarray(pre(jnp.asarray(mb.data), ctx))
+            for mb in loader.batches("valid", shuffle=False)
+        ]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_device_resident_trains_end_to_end(self, packed_dir):
+        from znicz_tpu.workflow import StandardWorkflow
+
+        prng.seed_all(13)
+        loader = ImageNetLoader(
+            packed_dir, crop_size=27, minibatch_size=8,
+            device_resident=True,
+        )
+        wf = StandardWorkflow(
+            loader,
+            [
+                {"type": "conv_relu", "->": {"n_kernels": 8, "kx": 5,
+                                             "ky": 5}},
+                {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+                {"type": "softmax", "->": {"output_sample_shape": 3}},
+            ],
+            decision_config={"max_epochs": 2},
+            default_hyper={"learning_rate": 0.05, "gradient_moment": 0.9},
+        )
+        wf.initialize(seed=13)
+        verdict = wf.run_epoch()
+        assert np.isfinite(verdict["summary"]["train"]["loss"])
+
     def test_raw_image_dir_autopacks(self, image_dir):
         loader = ImageNetLoader(
             image_dir, crop_size=24, pack_size=28, minibatch_size=8

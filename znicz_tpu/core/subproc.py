@@ -115,6 +115,38 @@ def train_member(payload: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def warn_if_shared_accelerator(n_workers: int, device) -> None:
+    """Warn when N>1 spawned jax workers would target one accelerator
+    chip (each re-initializes jax and contends for it); the documented
+    recipe is device='cpu' / --device cpu for concurrent evaluations."""
+    if n_workers <= 1 or device == "cpu":
+        return
+    import warnings
+
+    try:
+        # NEVER initialize a backend just to warn: on TPU the parent
+        # would acquire the chip exclusively and the spawned workers
+        # could no longer initialize it at all.  Only consult jax when
+        # the parent already initialized it (then the query is free).
+        from jax._src.xla_bridge import backends_are_initialized
+
+        if not backends_are_initialized():
+            return
+        import jax
+
+        backend = jax.default_backend()
+        n_chips = jax.device_count()
+    except Exception:  # backend/private API unavailable
+        return
+    if backend in ("tpu", "axon") and n_chips < n_workers:
+        warnings.warn(
+            f"{n_workers} worker processes will contend for {n_chips} "
+            "accelerator chip(s); pass device='cpu' (--device cpu) for "
+            "concurrent evaluations on a shared chip",
+            stacklevel=3,
+        )
+
+
 def run_pool(fn, payloads: List[Dict[str, Any]], n_workers: int) -> list:
     """Map ``fn`` over payloads with n_workers spawned processes (order
     preserved).  n_workers<=1 still uses ONE worker process so results are

@@ -163,8 +163,10 @@ def train_from_module(
         _run_workflow_module,
         run_pool,
         train_member,
+        warn_if_shared_accelerator,
     )
 
+    warn_if_shared_accelerator(n_workers, device)
     seeds = [base_seed + 1000 * i for i in range(n_models)]
     with tempfile.TemporaryDirectory(prefix="znicz_ens_") as tmp:
         payloads = [

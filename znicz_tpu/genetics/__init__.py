@@ -242,9 +242,14 @@ def optimize_workflow(
 
     evaluate_batch = None
     if n_workers >= 1:
-        from znicz_tpu.core.subproc import eval_genome, run_pool
+        from znicz_tpu.core.subproc import (
+            eval_genome,
+            run_pool,
+            warn_if_shared_accelerator,
+        )
 
         args = launcher.args
+        warn_if_shared_accelerator(n_workers, args.device)
 
         def evaluate_batch(genomes):
             payloads = [

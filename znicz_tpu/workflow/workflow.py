@@ -449,20 +449,22 @@ class Workflow(Logger):
         """One dispatch per split: stack the epoch's host-side batch
         payloads and scan.  Split order (train, valid, test) matches the
         stepwise path, so results are identical."""
-        per_split: Dict[str, list] = {}
-        for split, mb in self.loader.epoch():
-            per_split.setdefault(split, []).append(mb)
+        with self.timer.phase("loader_epoch"):
+            per_split: Dict[str, list] = {}
+            for split, mb in self.loader.epoch():
+                per_split.setdefault(split, []).append(mb)
         accs: Dict[str, jax.Array] = {}
         for split, mbs in per_split.items():
-            xs = self._put_stacked(np.stack([mb.data for mb in mbs]))
-            ys = (
-                xs
-                if self.target == "input"
-                else self._put_stacked(
-                    np.stack([self._batch_target(mb) for mb in mbs])
+            with self.timer.phase(f"stack/{split}"):
+                xs = self._put_stacked(np.stack([mb.data for mb in mbs]))
+                ys = (
+                    xs
+                    if self.target == "input"
+                    else self._put_stacked(
+                        np.stack([self._batch_target(mb) for mb in mbs])
+                    )
                 )
-            )
-            masks = self._put_stacked(np.stack([mb.mask for mb in mbs]))
+                masks = self._put_stacked(np.stack([mb.mask for mb in mbs]))
             with self.timer.phase(f"dispatch/{split}"):
                 if split == TRAIN:
                     lrs_host = np.asarray(
