@@ -155,6 +155,8 @@ class TestSharedAcceleratorWarning:
 
         from znicz_tpu.core import subproc
 
+        jax.devices()  # the parent-side check only fires on an
+        # already-initialized backend (it must never initialize one)
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
         monkeypatch.setattr(jax, "device_count", lambda: 1)
         with warnings.catch_warnings(record=True) as w:
@@ -167,6 +169,25 @@ class TestSharedAcceleratorWarning:
             subproc.warn_if_shared_accelerator(4, "cpu")
             subproc.warn_if_shared_accelerator(1, None)
         assert not w
+
+    def test_worker_side_check_fires_from_payload_tag(
+        self, monkeypatch, capsys
+    ):
+        # the in-worker twin covers the CLI path where the parent never
+        # initializes a backend (only one payload carries the tag)
+        import jax
+
+        from znicz_tpu.core import subproc
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(jax, "device_count", lambda: 1)
+        subproc._worker_warn_shared_chip({"warn_n_workers": 4})
+        assert "contend" in capsys.readouterr().err
+        subproc._worker_warn_shared_chip({})  # untagged: silent
+        subproc._worker_warn_shared_chip(
+            {"warn_n_workers": 4, "device": "cpu"}
+        )
+        assert capsys.readouterr().err == ""
 
 
 class TestOptimizeCLI:

@@ -129,6 +129,41 @@ class TestConv:
         assert g["weights"].shape == params["weights"].shape
         assert bool(jnp.any(g["weights"] != 0))
 
+    @pytest.mark.parametrize(
+        "h,c,k,ksz,s,padding",
+        [
+            (35, 3, 8, 11, 4, (0, 0, 0, 0)),  # AlexNet-conv1-shaped
+            (32, 3, 8, 4, 4, (0, 0, 0, 0)),   # kernel == stride (slice)
+            (34, 4, 8, 5, 2, (0, 0, 0, 0)),   # stride 2, odd kernel
+            (33, 2, 8, 3, 3, (1, 2, 1, 2)),   # explicit padding
+        ],
+    )
+    def test_space_to_depth_exact(self, h, c, k, ksz, s, padding):
+        # the re-layout computes the SAME conv (see ops/conv._s2d_conv);
+        # grads compared at reassociation tolerance
+        params = conv.init_params(c, k, kx=ksz, ky=ksz)
+        x = jnp.asarray(rand(2, h, h, c))
+        kw = dict(sliding=(s, s), padding=padding)
+        ref = conv.apply(params, x, space_to_depth="never", **kw)
+        s2d = conv.apply(params, x, space_to_depth="always", **kw)
+        np.testing.assert_allclose(
+            np.asarray(s2d), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+        def loss(mode):
+            return lambda p: jnp.sum(
+                jnp.sin(conv.apply(p, x, space_to_depth=mode, **kw))
+            )
+
+        g1 = jax.grad(loss("never"))(params)
+        g2 = jax.grad(loss("always"))(params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+            )
+
 
 class TestPooling:
     def test_max_matches_naive(self):

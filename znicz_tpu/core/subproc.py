@@ -77,6 +77,34 @@ def _run_workflow_module(
     return launcher, box.get("decision")
 
 
+def _worker_warn_shared_chip(payload: Dict[str, Any]) -> None:
+    """In-worker twin of :func:`warn_if_shared_accelerator` for the case
+    where the PARENT never initialized a backend (the normal CLI path —
+    initializing one there just to warn would seize the TPU the workers
+    need).  The caller tags exactly one payload with ``warn_n_workers``;
+    this runs after the worker's own backend init, so the query is free."""
+    n = payload.get("warn_n_workers")
+    if not n or payload.get("device") == "cpu":
+        return
+    import sys
+
+    import jax
+
+    try:
+        backend = jax.default_backend()
+        n_chips = jax.device_count()
+    except Exception:
+        return
+    if backend in ("tpu", "axon") and n_chips < n:
+        print(
+            f"WARNING: {n} worker processes will contend for {n_chips} "
+            "accelerator chip(s); pass device='cpu' (--device cpu) for "
+            "concurrent evaluations on a shared chip",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
 def eval_genome(payload: Dict[str, Any]) -> float:
     """Worker: one genetic-search evaluation; returns fitness (lower is
     better).  Payload keys: workflow, config, seed, stop_after, device,
@@ -89,6 +117,9 @@ def eval_genome(payload: Dict[str, Any]) -> float:
         device=payload.get("device"),
         genome=payload["genome"],
     )
+    # after the module ran: the backend is initialized per the payload's
+    # device choice, so the contention check is a free query
+    _worker_warn_shared_chip(payload)
     if dec is None or dec.best_value is None:
         return float("inf")
     return float(dec.best_value)
@@ -106,6 +137,7 @@ def train_member(payload: Dict[str, Any]) -> Dict[str, Any]:
         stop_after=payload.get("stop_after"),
         device=payload.get("device"),
     )
+    _worker_warn_shared_chip(payload)
     params = jax.device_get(launcher.workflow.state.params)
     with open(payload["params_path"], "wb") as f:
         pickle.dump(params, f)

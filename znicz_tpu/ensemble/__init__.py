@@ -180,6 +180,9 @@ def train_from_module(
             }
             for i, seed in enumerate(seeds)
         ]
+        if payloads and n_workers > 1:
+            # first worker re-checks contention after its backend init
+            payloads[0]["warn_n_workers"] = n_workers
         results = run_pool(train_member, payloads, n_workers)
         member_params = []
         for r in results:

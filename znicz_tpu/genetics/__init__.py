@@ -263,6 +263,10 @@ def optimize_workflow(
                 }
                 for genome in genomes
             ]
+            if payloads and n_workers > 1:
+                # first worker re-checks contention AFTER its backend
+                # initializes (the parent may never initialize one)
+                payloads[0]["warn_n_workers"] = n_workers
             return run_pool(eval_genome, payloads, n_workers)
 
         evaluate = None  # all evaluations go through the worker pool

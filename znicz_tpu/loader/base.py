@@ -207,6 +207,23 @@ class Loader:
             prng.get(self.rand_name).load_state_dict(state["prng"])
 
 
+def pool_offsets(splits: Dict[str, "np.ndarray"]) -> Dict[str, int]:
+    """Row offset of each split inside the device-resident pool.  The ONE
+    ordering contract shared with :func:`pool_concat` — device-resident
+    loaders must never maintain it independently."""
+    offsets, off = {}, 0
+    for s in sorted(splits):
+        offsets[s] = off
+        off += len(splits[s])
+    return offsets
+
+
+def pool_concat(splits: Dict[str, "np.ndarray"]) -> np.ndarray:
+    """Concatenate split arrays in :func:`pool_offsets` order (transient
+    host copy; callers device_put it and drop the reference)."""
+    return np.concatenate([np.asarray(splits[s]) for s in sorted(splits)])
+
+
 def split_sizes(n: int, fractions: Sequence[float]) -> Dict[str, int]:
     """Partition ``n`` samples into train/valid/test by fractions
     (train gets the remainder)."""

@@ -14,7 +14,13 @@ from typing import Dict, Optional
 import numpy as np
 
 from znicz_tpu.loader import normalizers
-from znicz_tpu.loader.base import SPLITS, Loader, Minibatch
+from znicz_tpu.loader.base import (
+    SPLITS,
+    Loader,
+    Minibatch,
+    pool_concat,
+    pool_offsets,
+)
 
 
 class FullBatchLoader(Loader):
@@ -90,12 +96,9 @@ class FullBatchLoader(Loader):
         # epoch of them is bytes, so the workflow may compile each split as
         # ONE lax.scan dispatch (Workflow._use_epoch_scan)
         self.epoch_scan_friendly = device_resident
-        self._pool_offsets: Dict[str, int] = {}
-        if device_resident:
-            offset = 0
-            for s in sorted(self.data):
-                self._pool_offsets[s] = offset
-                offset += len(self.data[s])
+        self._pool_offsets: Dict[str, int] = (
+            pool_offsets(self.data) if device_resident else {}
+        )
         if not self._lazy_u8:
             # Normalize each immutable split ONCE here, not per minibatch.
             self.data = {
@@ -113,9 +116,8 @@ class FullBatchLoader(Loader):
         # workflow device_puts it, so keeping a concatenated host copy next
         # to self.data would double host RAM for exactly the datasets this
         # mode targets.  (np.concatenate still peaks at 2x transiently.)
-        return {
-            "pool": np.concatenate([self.data[s] for s in sorted(self.data)])
-        }
+        # base.pool_concat uses the same ordering _pool_offsets came from.
+        return {"pool": pool_concat(self.data)}
 
     def device_preproc(self):
         import jax.numpy as jnp

@@ -28,7 +28,14 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from znicz_tpu.core import prng
-from znicz_tpu.loader.base import SPLITS, TRAIN, Loader, Minibatch
+from znicz_tpu.loader.base import (
+    SPLITS,
+    TRAIN,
+    Loader,
+    Minibatch,
+    pool_concat as base_pool_concat,
+    pool_offsets as base_pool_offsets,
+)
 from znicz_tpu.loader.image import IMAGE_EXTENSIONS, _read_image
 
 MEAN_FILE = "mean_rgb.json"
@@ -164,8 +171,7 @@ class ImageNetLoader(Loader):
         # tiny per-batch payloads enable the scanned epoch dispatch.
         self._device_resident = bool(device_resident)
         self.epoch_scan_friendly = self._device_resident
-        self._pool_order: list = []  # filled after images load (below)
-        self._pool_offsets: Dict[str, int] = {}
+        self._pool_offsets: Dict[str, int] = {}  # set after images load
         if not os.path.isdir(data_dir):
             raise FileNotFoundError(f"no such data_dir: {data_dir}")
         if not os.path.exists(os.path.join(data_dir, f"{TRAIN}_images.npy")):
@@ -207,13 +213,8 @@ class ImageNetLoader(Loader):
                 else (0.5, 0.5, 0.5)
             )
         self.mean_rgb = np.asarray(mean_rgb, np.float32)
-        # fixed split order for the device-resident pool: offsets and the
-        # device_context concatenation must always agree
-        self._pool_order = sorted(self.images)
-        off = 0
-        for s in self._pool_order:
-            self._pool_offsets[s] = off
-            off += len(self.images[s])
+        # offsets/concatenation ordering lives in ONE place: loader.base
+        self._pool_offsets = base_pool_offsets(self.images)
 
     # -- Loader interface --------------------------------------------------
     @property
@@ -287,15 +288,9 @@ class ImageNetLoader(Loader):
     def device_context(self):
         if not self._device_resident:
             return None
-        # one up-front transfer of the packed pool (np.concatenate is a
-        # transient host copy; the workflow device_puts and drops it);
-        # MUST concatenate in the same split order _pool_offsets was
-        # built from (self._pool_order, fixed at __init__)
-        return {
-            "pool": np.concatenate(
-                [np.asarray(self.images[s]) for s in self._pool_order]
-            )
-        }
+        # one up-front transfer of the packed pool; base.pool_concat uses
+        # the same ordering _pool_offsets was built from
+        return {"pool": base_pool_concat(self.images)}
 
     def device_preproc(self):
         """u8 -> f32 in [-mean, 1-mean]: runs inside the jitted step.

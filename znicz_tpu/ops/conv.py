@@ -60,6 +60,47 @@ def _norm_padding(padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
     return ((top, bottom), (left, right))
 
 
+def _s2d_conv(x, w, s: int, pref):
+    """Strided conv as a stride-1 conv over space-to-depth input — exact.
+
+    A stride-s KxK conv on C channels keeps the MXU contraction dim at
+    K*K*C taps but feeds it C-channel-thin input; for stem layers (C=3)
+    the systolic array pads the channel dim and utilization craters.
+    Regrouping s x s input blocks into channels (C -> s*s*C) and the
+    kernel into ceil(K/s) x ceil(K/s) taps over those channels computes
+    the SAME sums with an MXU-shaped contraction.  Zero-padded kernel
+    taps/input rows contribute nothing, so the result is exact up to
+    float reassociation."""
+    b, h, wd, c = x.shape
+    ky, kx, _, k = w.shape
+    oh = (h - ky) // s + 1
+    ow = (wd - kx) // s + 1
+    kyp, kxp = -(-ky // s) * s, -(-kx // s) * s
+    if (kyp, kxp) != (ky, kx):
+        w = jnp.pad(w, ((0, kyp - ky), (0, kxp - kx), (0, 0), (0, 0)))
+    hn, wn = (oh - 1) * s + kyp, (ow - 1) * s + kxp
+    # rows/cols past hn/wn are never read by any output; short inputs
+    # (kernel already a stride multiple) slice, long ones zero-pad
+    x = x[:, :hn, :wn] if (hn <= h and wn <= wd) else jnp.pad(
+        x, ((0, 0), (0, max(hn - h, 0)), (0, max(wn - wd, 0)), (0, 0))
+    )[:, :hn, :wn]
+    x = (
+        x.reshape(b, hn // s, s, wn // s, s, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b, hn // s, wn // s, s * s * c)
+    )
+    w = (
+        w.reshape(kyp // s, s, kxp // s, s, c, k)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(kyp // s, kxp // s, s * s * c, k)
+    )
+    return lax.conv_general_dilated(
+        x, w, (1, 1), "VALID",
+        dimension_numbers=DIMENSION_NUMBERS,
+        preferred_element_type=pref,
+    )
+
+
 def apply(
     params: Dict[str, jnp.ndarray],
     x: jnp.ndarray,
@@ -67,22 +108,48 @@ def apply(
     sliding: Sequence[int] = (1, 1),
     padding=(0, 0, 0, 0),
     activation: str = "linear",
+    space_to_depth: str = "never",  # "auto" | "always" | "never"
 ) -> jnp.ndarray:
-    """Forward conv, NHWC.  ``sliding`` is (sx, sy) per the reference."""
+    """Forward conv, NHWC.  ``sliding`` is (sx, sy) per the reference.
+
+    ``space_to_depth``: strided thin-channel stems (e.g. AlexNet conv1,
+    stride 4 on RGB) re-layout via :func:`_s2d_conv` so the MXU sees an
+    s*s*C-channel contraction instead of a C-channel one.  "auto" applies
+    it when both strides equal s > 1 and C <= 4.  Default "never" —
+    MEASURED on v5e (AlexNet conv1, B=1024, bf16): s2d forward is SLOWER
+    (5.4 vs 2.8 ms — XLA's native strided conv handles the thin stem
+    well) and its big win, the input gradient (13.4 vs 19.4 ms
+    fwd+input-grad), is dead code for a first layer (no upstream), so
+    the end-to-end train step does not move (79.7 vs 78.5 ms).  Use
+    "auto"/"always" for strided thin-channel convs DEEPER in a model,
+    where the input gradient is live."""
     pad = _norm_padding(padding)
     strides = (sliding[1], sliding[0])  # (sy, sx) -> spatial order (H, W)
     # bf16 inputs: emit bf16 (XLA still accumulates f32 on the TPU MXU);
     # requesting an f32 output here would put an astype on the transpose
     # path and break the conv gradient's dtype matching.
     pref = jnp.float32 if x.dtype == jnp.float32 else None
-    y = lax.conv_general_dilated(
-        x,
-        params["weights"],
-        window_strides=strides,
-        padding=pad,
-        dimension_numbers=DIMENSION_NUMBERS,
-        preferred_element_type=pref,
+    s = strides[0]
+    use_s2d = (
+        space_to_depth in ("auto", "always")
+        and s > 1
+        and strides[0] == strides[1]
+        and not isinstance(pad, str)  # SAME/VALID strings: plain path
+        and (space_to_depth == "always" or x.shape[-1] <= 4)
     )
+    if use_s2d:
+        if any(p for pq in pad for p in pq):
+            x = jnp.pad(x, ((0, 0), pad[0], pad[1], (0, 0)))
+        y = _s2d_conv(x, params["weights"], s, pref)
+    else:
+        y = lax.conv_general_dilated(
+            x,
+            params["weights"],
+            window_strides=strides,
+            padding=pad,
+            dimension_numbers=DIMENSION_NUMBERS,
+            preferred_element_type=pref,
+        )
     y = y + params["bias"]
     return act.get(activation)(y).astype(x.dtype)
 
