@@ -116,6 +116,57 @@ print(f"OK process={jax.process_index()}")
 """
 
 
+SCAN_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, sys.argv[3])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from znicz_tpu.parallel import multihost
+
+multihost.initialize(
+    coordinator_address=sys.argv[1], num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+
+import numpy as np
+from znicz_tpu.core import prng
+from znicz_tpu.loader import FullBatchLoader
+from znicz_tpu.parallel import DataParallel, make_mesh
+from znicz_tpu.workflow import StandardWorkflow
+
+gen = np.random.default_rng(0)
+imgs = gen.integers(0, 256, (256, 64), dtype=np.uint8)
+labels = gen.integers(0, 10, 256).astype(np.int32)
+prng.seed_all(77)
+loader = FullBatchLoader(
+    {"train": imgs}, {"train": labels}, minibatch_size=64,
+    normalization="range", normalization_kwargs={"scale": 255.0,
+                                                 "shift": -0.5},
+    device_resident=True,
+)
+wf = StandardWorkflow(
+    loader,
+    [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 32}},
+        {"type": "softmax", "->": {"output_sample_shape": 10}},
+    ],
+    decision_config={"max_epochs": 3},
+    default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+)
+wf.parallel = DataParallel(make_mesh(2, 1))
+wf.initialize(seed=77)
+assert wf._use_epoch_scan(), "device-resident loader must take the scan path"
+dec = wf.run()
+hist = [e["train"]["loss"] for e in dec.history]
+print("HIST" + str(jax.process_index()) + "=" + json.dumps(hist))
+print(f"OK process={jax.process_index()}")
+"""
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -237,3 +288,73 @@ def test_two_process_training_matches_single_process(tmp_path):
     )
     assert any(f.startswith("workflow") for f in wrote0), wrote0
     assert wrote1 == [], wrote1
+
+
+def test_two_process_device_resident_scan_training(tmp_path):
+    """Multi-host x device-resident x scanned dispatch: the HBM pool is
+    replicated per process, each process stacks only ITS loader shard, and
+    the whole-split lax.scan runs over global arrays — losses must match
+    the single-process run of the identical config."""
+    import json
+
+    import numpy as np
+
+    addr = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", SCAN_WORKER, addr, str(pid), REPO],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host scan worker timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err}"
+    hists = {}
+    for _, out, _ in outs:
+        for line in out.splitlines():
+            if line.startswith("HIST"):
+                pid, _, payload = line[4:].partition("=")
+                hists[int(pid)] = json.loads(payload)
+    assert hists[0] == hists[1]
+
+    # single-process baseline of the same config
+    from znicz_tpu.core import prng
+    from znicz_tpu.loader import FullBatchLoader
+    from znicz_tpu.workflow import StandardWorkflow
+
+    gen = np.random.default_rng(0)
+    imgs = gen.integers(0, 256, (256, 64), dtype=np.uint8)
+    labels = gen.integers(0, 10, 256).astype(np.int32)
+    prng.seed_all(77)
+    loader = FullBatchLoader(
+        {"train": imgs}, {"train": labels}, minibatch_size=64,
+        normalization="range",
+        normalization_kwargs={"scale": 255.0, "shift": -0.5},
+        device_resident=True,
+    )
+    wf = StandardWorkflow(
+        loader,
+        [
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 32}},
+            {"type": "softmax", "->": {"output_sample_shape": 10}},
+        ],
+        decision_config={"max_epochs": 3},
+        default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+    )
+    wf.initialize(seed=77)
+    base = [e["train"]["loss"] for e in wf.run().history]
+    np.testing.assert_allclose(base, hists[0], rtol=1e-4)

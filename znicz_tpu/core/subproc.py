@@ -84,13 +84,19 @@ def _worker_warn_shared_chip(payload: Dict[str, Any]) -> None:
     need).  The caller tags exactly one payload with ``warn_n_workers``;
     this runs after the worker's own backend init, so the query is free."""
     n = payload.get("warn_n_workers")
-    if not n or payload.get("device") == "cpu":
+    device = payload.get("device")
+    if not n or device == "cpu":
         return
     import sys
 
     import jax
 
     try:
+        if device:  # mirror _run_workflow_module's platform choice so the
+            # warning probe initializes the SAME backend the run will use
+            jax.config.update(
+                "jax_platforms", "cpu" if device == "cpu" else "tpu,axon"
+            )
         backend = jax.default_backend()
         n_chips = jax.device_count()
     except Exception:
@@ -109,6 +115,7 @@ def eval_genome(payload: Dict[str, Any]) -> float:
     """Worker: one genetic-search evaluation; returns fitness (lower is
     better).  Payload keys: workflow, config, seed, stop_after, device,
     genome."""
+    _worker_warn_shared_chip(payload)  # BEFORE the (possibly contended) run
     _, dec = _run_workflow_module(
         payload["workflow"],
         payload.get("config"),
@@ -117,9 +124,6 @@ def eval_genome(payload: Dict[str, Any]) -> float:
         device=payload.get("device"),
         genome=payload["genome"],
     )
-    # after the module ran: the backend is initialized per the payload's
-    # device choice, so the contention check is a free query
-    _worker_warn_shared_chip(payload)
     if dec is None or dec.best_value is None:
         return float("inf")
     return float(dec.best_value)
@@ -130,6 +134,7 @@ def train_member(payload: Dict[str, Any]) -> Dict[str, Any]:
     ``payload['params_path']`` and returns {'best_value', 'params_path'}."""
     import jax
 
+    _worker_warn_shared_chip(payload)  # BEFORE the (possibly contended) run
     launcher, dec = _run_workflow_module(
         payload["workflow"],
         payload.get("config"),
@@ -137,7 +142,6 @@ def train_member(payload: Dict[str, Any]) -> Dict[str, Any]:
         stop_after=payload.get("stop_after"),
         device=payload.get("device"),
     )
-    _worker_warn_shared_chip(payload)
     params = jax.device_get(launcher.workflow.state.params)
     with open(payload["params_path"], "wb") as f:
         pickle.dump(params, f)
@@ -147,12 +151,14 @@ def train_member(payload: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
-def warn_if_shared_accelerator(n_workers: int, device) -> None:
+def warn_if_shared_accelerator(n_workers: int, device) -> bool:
     """Warn when N>1 spawned jax workers would target one accelerator
     chip (each re-initializes jax and contends for it); the documented
-    recipe is device='cpu' / --device cpu for concurrent evaluations."""
+    recipe is device='cpu' / --device cpu for concurrent evaluations.
+    Returns True when the warning fired (callers then skip the in-worker
+    twin)."""
     if n_workers <= 1 or device == "cpu":
-        return
+        return False
     import warnings
 
     try:
@@ -163,13 +169,13 @@ def warn_if_shared_accelerator(n_workers: int, device) -> None:
         from jax._src.xla_bridge import backends_are_initialized
 
         if not backends_are_initialized():
-            return
+            return False
         import jax
 
         backend = jax.default_backend()
         n_chips = jax.device_count()
     except Exception:  # backend/private API unavailable
-        return
+        return False
     if backend in ("tpu", "axon") and n_chips < n_workers:
         warnings.warn(
             f"{n_workers} worker processes will contend for {n_chips} "
@@ -177,6 +183,8 @@ def warn_if_shared_accelerator(n_workers: int, device) -> None:
             "concurrent evaluations on a shared chip",
             stacklevel=3,
         )
+        return True
+    return False
 
 
 def run_pool(fn, payloads: List[Dict[str, Any]], n_workers: int) -> list:

@@ -166,7 +166,7 @@ def train_from_module(
         warn_if_shared_accelerator,
     )
 
-    warn_if_shared_accelerator(n_workers, device)
+    parent_warned = warn_if_shared_accelerator(n_workers, device)
     seeds = [base_seed + 1000 * i for i in range(n_models)]
     with tempfile.TemporaryDirectory(prefix="znicz_ens_") as tmp:
         payloads = [
@@ -180,8 +180,9 @@ def train_from_module(
             }
             for i, seed in enumerate(seeds)
         ]
-        if payloads and n_workers > 1:
-            # first worker re-checks contention after its backend init
+        if payloads and n_workers > 1 and not parent_warned:
+            # first worker checks contention from ITS backend (the parent
+            # may never initialize one)
             payloads[0]["warn_n_workers"] = n_workers
         results = run_pool(train_member, payloads, n_workers)
         member_params = []

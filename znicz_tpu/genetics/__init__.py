@@ -249,7 +249,10 @@ def optimize_workflow(
         )
 
         args = launcher.args
-        warn_if_shared_accelerator(n_workers, args.device)
+        # one contention warning per SEARCH: parent-side if its backend is
+        # already up, else the first worker of the first generation
+        parent_warned = warn_if_shared_accelerator(n_workers, args.device)
+        pending_worker_warn = not parent_warned and n_workers > 1
 
         def evaluate_batch(genomes):
             payloads = [
@@ -263,9 +266,11 @@ def optimize_workflow(
                 }
                 for genome in genomes
             ]
-            if payloads and n_workers > 1:
-                # first worker re-checks contention AFTER its backend
-                # initializes (the parent may never initialize one)
+            nonlocal pending_worker_warn
+            if payloads and pending_worker_warn:
+                # first worker of the first generation checks contention
+                # from ITS backend (the parent may never initialize one)
+                pending_worker_warn = False
                 payloads[0]["warn_n_workers"] = n_workers
             return run_pool(eval_genome, payloads, n_workers)
 

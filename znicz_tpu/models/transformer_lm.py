@@ -73,6 +73,7 @@ def build_workflow(**overrides) -> TransformerLMWorkflow:
         "n_layers": cfg.get("n_layers", 2),
         "n_heads": cfg.get("n_heads", 4),
         "max_epochs": cfg.get("max_epochs", 15),
+        "remat": bool(cfg.get("remat", False)),
         "name": "TransformerLMWorkflow",
     }
     pp_stages = int(cfg.get("pipeline_stages", 0) or 0)
