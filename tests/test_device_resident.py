@@ -1,0 +1,140 @@
+"""Device-resident loaders across EVERY workflow family.
+
+Round-3 verdict's one silent-wrong-results trap: `device_preproc` used to be
+applied only inside the base Workflow's steps, so a device-resident loader
+(whose minibatch payload is a bare pool-index vector) fed *indices as data*
+to Transformer/SOM/RBM workflows.  The preproc now lives in
+``Workflow._finalize_steps`` — these tests pin the contract: for every
+workflow family, device_resident=True trains IDENTICALLY to the streaming
+loader (same seeds, same order, same math — any index leak would destroy
+the equality).
+"""
+
+import numpy as np
+
+from znicz_tpu.core import prng
+from znicz_tpu.loader.fullbatch import FullBatchLoader
+from znicz_tpu.workflow import StandardWorkflow
+from znicz_tpu.workflow.transformer import TransformerLMWorkflow
+from znicz_tpu.workflow.unsupervised import KohonenWorkflow, RBMWorkflow
+
+
+def _assert_histories_equal(a, b, *, rtol=1e-5, atol=1e-7):
+    assert len(a) == len(b)
+    for ea, eb in zip(a, b):
+        assert ea.keys() == eb.keys()
+        for split in ea:
+            np.testing.assert_allclose(
+                ea[split]["loss"], eb[split]["loss"], rtol=rtol, atol=atol
+            )
+
+
+class TestTransformerDeviceResident:
+    def _run(self, device_resident: bool):
+        prng.seed_all(41)
+        gen = np.random.default_rng(5)
+        # bigram-ish token streams, [N, T] ints
+        tokens = np.cumsum(
+            gen.integers(0, 3, (96, 16)), axis=1, dtype=np.int64
+        ) % 17
+        loader = FullBatchLoader(
+            {"train": tokens, "test": tokens[:32]},
+            minibatch_size=32,
+            device_resident=device_resident,
+        )
+        wf = TransformerLMWorkflow(
+            loader, vocab=17, d_model=16, n_layers=1, n_heads=2,
+            max_epochs=3, attention="dot",
+        )
+        wf.initialize(seed=41)
+        if device_resident:
+            assert wf._ctx is not None
+            assert wf._use_epoch_scan()  # inherits the scan dispatch win
+        dec = wf.run()
+        return dec.history, np.asarray(wf.state.params[0]["embed"])
+
+    def test_matches_streaming(self):
+        h_res, p_res = self._run(True)
+        h_str, p_str = self._run(False)
+        _assert_histories_equal(h_res, h_str)
+        np.testing.assert_allclose(p_res, p_str, rtol=1e-6, atol=1e-7)
+        # sanity: the LM actually learned (indices-as-tokens would plateau
+        # at uniform CE ~ log(17) = 2.83 or blow up on out-of-vocab values)
+        assert h_res[-1]["train"]["loss"] < h_res[0]["train"]["loss"]
+
+
+class TestKohonenDeviceResident:
+    def _run(self, device_resident: bool):
+        prng.seed_all(43)
+        gen = np.random.default_rng(7)
+        data = gen.normal(0.0, 1.0, (128, 12)).astype(np.float32)
+        loader = FullBatchLoader(
+            {"train": data},
+            minibatch_size=32,
+            device_resident=device_resident,
+        )
+        wf = KohonenWorkflow(
+            loader, sx=3, sy=3, total_epochs=3, impl="xla"
+        )
+        wf.initialize(seed=43)
+        dec = wf.run()
+        return dec.history, np.asarray(wf.state.params["weights"])
+
+    def test_matches_streaming(self):
+        h_res, w_res = self._run(True)
+        h_str, w_str = self._run(False)
+        _assert_histories_equal(h_res, h_str)
+        np.testing.assert_allclose(w_res, w_str, rtol=1e-6, atol=1e-7)
+
+
+class TestRBMDeviceResident:
+    def _run(self, device_resident: bool):
+        prng.seed_all(47)
+        gen = np.random.default_rng(9)
+        data = (gen.uniform(0, 1, (128, 24)) > 0.5).astype(np.float32)
+        loader = FullBatchLoader(
+            {"train": data},
+            minibatch_size=32,
+            device_resident=device_resident,
+        )
+        wf = RBMWorkflow(loader, n_hidden=8, max_epochs=3, impl="xla")
+        wf.initialize(seed=47)
+        dec = wf.run()
+        return dec.history, np.asarray(wf.state.params["weights"])
+
+    def test_matches_streaming(self):
+        h_res, w_res = self._run(True)
+        h_str, w_str = self._run(False)
+        _assert_histories_equal(h_res, h_str)
+        np.testing.assert_allclose(w_res, w_str, rtol=1e-6, atol=1e-7)
+
+
+class TestAutoencoderDeviceResident:
+    def test_target_is_preprocessed_input(self):
+        # target="input": the AE target must be the PREPROCESSED batch (the
+        # gathered pool rows), never the raw index payload
+        def run(device_resident):
+            prng.seed_all(53)
+            gen = np.random.default_rng(11)
+            images = gen.integers(0, 256, (96, 6, 6, 1), dtype=np.uint8)
+            loader = FullBatchLoader(
+                {"train": images},
+                minibatch_size=32,
+                normalization="range",
+                normalization_kwargs={"scale": 255.0, "shift": -0.5},
+                device_resident=device_resident,
+            )
+            wf = StandardWorkflow(
+                loader,
+                [{"type": "all2all_tanh", "->": {"output_sample_shape": 8}},
+                 {"type": "all2all", "->": {"output_sample_shape": (6, 6, 1)}}],
+                loss_function="mse",
+                target="input",
+                decision_config={"max_epochs": 3},
+                default_hyper={"learning_rate": 0.05,
+                               "gradient_moment": 0.9},
+            )
+            wf.initialize(seed=53)
+            return wf.run().history
+
+        _assert_histories_equal(run(True), run(False))

@@ -124,26 +124,16 @@ class Workflow(Logger):
 
     def _build_steps(self):
         model = self.model
-        # loader-provided on-device preprocessing (u8 -> f32 affine, mean
-        # subtraction, HBM-pool gather): fuses into the XLA program, so
-        # minibatches cross host->device as u8 (1/4 the bytes of f32) or as
-        # bare index vectors (device-resident datasets)
-        pre = self.loader.device_preproc()
-        target_is_input = self.target == "input"
 
-        def loss_fn(params, key, step, x, y, mask, ctx):
-            if pre is not None:
-                x = pre(x, ctx)
-                if target_is_input:  # AE target is the preprocessed input
-                    y = x
+        def loss_fn(params, key, step, x, y, mask):
             rng = jax.random.fold_in(key, step)
             out = model.apply(params, x, train=True, rng=rng)
             m = self._metrics(out, y, mask)
             return m["loss"], m
 
-        def train_step(state: TrainState, x, y, mask, lr_scale, ctx):
+        def train_step(state: TrainState, x, y, mask, lr_scale):
             grads, metrics = jax.grad(loss_fn, has_aux=True)(
-                state.params, state.key, state.step, x, y, mask, ctx
+                state.params, state.key, state.step, x, y, mask
             )
             hyper = [
                 h._replace(
@@ -166,20 +156,14 @@ class Workflow(Logger):
                 metrics,
             )
 
-        def eval_step(params, x, y, mask, ctx):
-            if pre is not None:
-                x = pre(x, ctx)
-                if target_is_input:
-                    y = x
+        def eval_step(params, x, y, mask):
             out = model.apply(params, x, train=False)
             return self._metrics(out, y, mask)
 
         if self.loss_function == "softmax":
             from znicz_tpu.nn import evaluator as _ev
 
-            def eval_conf_step(params, x, y, mask, ctx):
-                if pre is not None:
-                    x = pre(x, ctx)
+            def eval_conf_step(params, x, y, mask):
                 out = model.apply(params, x, train=False)
                 return _ev.softmax(out, y, mask=mask, compute_confusion=True)
 
@@ -188,8 +172,7 @@ class Workflow(Logger):
             eval_conf_step = None
             names = ["loss", "max_diff", "n_samples"]
         self._finalize_steps(
-            train_step, eval_step, names,
-            eval_conf_step=eval_conf_step, needs_ctx=True,
+            train_step, eval_step, names, eval_conf_step=eval_conf_step,
         )
 
     def _finalize_steps(
@@ -199,7 +182,6 @@ class Workflow(Logger):
         metric_names,
         *,
         eval_conf_step=None,
-        needs_ctx=False,
     ):
         """Jit the raw steps with ON-DEVICE epoch-metric accumulation.
 
@@ -224,23 +206,40 @@ class Workflow(Logger):
             vec = _encode_metrics(m, names)
             return jnp.where(add_mask, acc + vec, jnp.maximum(acc, vec))
 
-        # ``ctx`` is the loader's device_context (e.g. the HBM-resident
-        # dataset pool) — always an explicit jit ARGUMENT so XLA never
-        # embeds it in the executable; steps that predate the ctx arg
-        # (transformer, SOM/RBM) simply don't receive it.
+        # Loader-provided on-device preprocessing (u8 -> f32 affine, mean
+        # subtraction, HBM-pool gather) is applied HERE, outside the raw
+        # steps, so EVERY workflow — backprop, transformer, SOM, RBM —
+        # consumes the loader's device context the same way.  A loader that
+        # ships index vectors (device_resident=True) therefore can never
+        # leak bare indices into a model as data.  ``ctx`` is the device
+        # context pytree: always an explicit jit ARGUMENT so XLA never
+        # embeds it in the executable.
+        pre = self.loader.device_preproc()
+        target_is_input = self.target == "input"
+
+        def prep(x, y, ctx):
+            if pre is None:
+                return x, y
+            x = pre(x, ctx)
+            return x, (x if target_is_input else y)  # AE target = preproc'd x
+
+        def train_step_full(state, x, y, mask, lr_scale, ctx):
+            x, y = prep(x, y, ctx)
+            return train_step(state, x, y, mask, lr_scale)
+
         def train_acc(state, x, y, mask, lr_scale, acc, ctx):
-            args = (state, x, y, mask, lr_scale) + ((ctx,) if needs_ctx else ())
-            state2, m = train_step(*args)
+            state2, m = train_step_full(state, x, y, mask, lr_scale, ctx)
             return state2, combine(acc, m)
 
         def eval_acc(params, x, y, mask, acc, ctx):
-            args = (params, x, y, mask) + ((ctx,) if needs_ctx else ())
-            return combine(acc, eval_step(*args))
+            x, y = prep(x, y, ctx)
+            return combine(acc, eval_step(params, x, y, mask))
 
         # un-jitted step kept public: benchmarks/tools can embed it in their
         # own compiled programs (e.g. a lax.fori_loop of steps for device-
-        # side latency measurement without per-step dispatch overhead)
-        self.train_step_fn = train_step
+        # side latency measurement without per-step dispatch overhead); the
+        # loader preproc is included so callers pass raw minibatch payloads
+        self.train_step_fn = train_step_full
         self._train_step = jax.jit(train_acc, donate_argnums=(0, 5))
         self._eval_step = jax.jit(eval_acc, donate_argnums=(4,))
 
@@ -276,8 +275,8 @@ class Workflow(Logger):
         if eval_conf_step is not None:
 
             def eval_conf_acc(params, x, y, mask, acc, conf, ctx):
-                args = (params, x, y, mask) + ((ctx,) if needs_ctx else ())
-                m = eval_conf_step(*args)
+                x, y = prep(x, y, ctx)
+                m = eval_conf_step(params, x, y, mask)
                 c = m.pop("confusion")
                 return combine(acc, m), conf + c
 
