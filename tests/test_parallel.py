@@ -49,6 +49,51 @@ class TestMesh:
         with pytest.raises(ValueError):
             dp.shard_batch(np.zeros((10, 4), np.float32))
 
+    def test_process_contiguous_data_axis_check(self):
+        # the multi-host loader row contract needs each process to own one
+        # contiguous block of the data axis; the checker only touches
+        # .axis_names/.devices/.process_index, so duck-typed meshes with
+        # fake process placements exercise both verdicts
+        from types import SimpleNamespace
+
+        from znicz_tpu.parallel.mesh import (
+            verify_process_contiguous_data_axis,
+        )
+
+        def fake_mesh(proc_grid, axis_names=("data", "model")):
+            devices = np.vectorize(
+                lambda p: SimpleNamespace(process_index=int(p))
+            )(np.asarray(proc_grid))
+            return SimpleNamespace(axis_names=axis_names, devices=devices)
+
+        # contiguous: processes 0,0,1,1 down the data axis (model in-proc)
+        verify_process_contiguous_data_axis(
+            fake_mesh([[0, 0], [0, 0], [1, 1], [1, 1]])
+        )
+        # interleaved processes along data
+        with pytest.raises(ValueError, match="contiguous block"):
+            verify_process_contiguous_data_axis(
+                fake_mesh([[0, 0], [1, 1], [0, 0], [1, 1]])
+            )
+        # a data-axis row mixing two processes
+        with pytest.raises(ValueError, match="contiguous block"):
+            verify_process_contiguous_data_axis(
+                fake_mesh([[0, 1], [0, 1], [0, 1], [0, 1]])
+            )
+        # 1-D (data-only) meshes must be checked too, not crash
+        verify_process_contiguous_data_axis(
+            fake_mesh([0, 0, 1, 1], axis_names=("data",))
+        )
+        with pytest.raises(ValueError, match="contiguous block"):
+            verify_process_contiguous_data_axis(
+                fake_mesh([0, 1, 0, 1], axis_names=("data",))
+            )
+        # contiguous but UNEQUAL shares break the loader's 1/P row contract
+        with pytest.raises(ValueError, match="equal"):
+            verify_process_contiguous_data_axis(
+                fake_mesh([0, 0, 0, 1], axis_names=("data",))
+            )
+
 
 class TestDataParallelTraining:
     def test_dp_matches_single_device(self):

@@ -262,6 +262,19 @@ class TestTransformerWorkflow:
             pipeline_parallel=True, mesh=make_mesh(1, 1, 4),
         )
         assert wf2.pipeline_microbatches == 16
+        # under DPxPP the auto-selection must also keep microbatch rows
+        # divisible by the data axis: bs=24, S=2, data=4 — the plain
+        # divisor search would pick m=12 (rows 2, not divisible by 4) and
+        # fail later in pipeline_apply; the constrained search picks m=6
+        from znicz_tpu.parallel import DataParallel
+
+        ld3 = FullBatchLoader({"train": tokens.copy()}, minibatch_size=24)
+        wf3 = TransformerLMWorkflow(
+            ld3, vocab=16, d_model=32, n_layers=4, n_heads=2,
+            pipeline_parallel=True, parallel=DataParallel(make_mesh(4, 1, 2)),
+        )
+        assert wf3.pipeline_microbatches == 6
+        assert (24 // wf3.pipeline_microbatches) % 4 == 0
 
     def test_sequence_parallel_flash_inner_matches_dense(self):
         # SP long context at kernel speed: ring(inner=flash) trains to the

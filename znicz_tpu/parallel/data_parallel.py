@@ -100,6 +100,14 @@ class DataParallel:
         param_rules=None,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
+        if jax.process_count() > 1:
+            # the loader's per-process row contract only holds when each
+            # process owns one contiguous block of the data axis
+            from znicz_tpu.parallel.mesh import (
+                verify_process_contiguous_data_axis,
+            )
+
+            verify_process_contiguous_data_axis(self.mesh)
         self.tp = tp and self.mesh.shape[MODEL_AXIS] > 1
         self.tp_min_features = tp_min_features
         # param_rules: callable (path_str, leaf) -> PartitionSpec or None.

@@ -93,6 +93,42 @@ def mesh_from_spec(
     )
 
 
+def verify_process_contiguous_data_axis(mesh: Mesh) -> None:
+    """Check the multi-host row-ownership contract: each process's devices
+    occupy one contiguous, process-pure block of the ``data`` axis, in
+    process order.  ``Loader.set_process_shard`` serves process ``p`` rows
+    ``[p*B/P, (p+1)*B/P)`` of every global minibatch, and
+    ``DataParallel.shard_batch`` assembles them via
+    ``jax.make_array_from_process_local_data`` — which places global row
+    block ``d`` on ``mesh.devices[d]``.  A mesh whose device order
+    interleaves processes would silently hand each process's rows different
+    global positions than the loader contract states.  jax's default device
+    order is process-contiguous, so this only trips hand-built meshes.
+    """
+    axes = list(mesh.axis_names)
+    if DATA_AXIS not in axes:
+        return
+    dev = np.moveaxis(np.asarray(mesh.devices), axes.index(DATA_AXIS), 0)
+    dev = dev.reshape(dev.shape[0], -1)  # 1-D (data-only) meshes included
+    rows = [sorted({dv.process_index for dv in row}) for row in dev]
+    procs = [r[0] for r in rows]
+    counts = [procs.count(p) for p in sorted(set(procs))]
+    if (
+        any(len(r) != 1 for r in rows)
+        or procs != sorted(procs)
+        # the loader serves EQUAL 1/P row blocks, so unequal data-axis
+        # shares violate the contract even when blocks are contiguous
+        or len(set(counts)) > 1
+    ):
+        raise ValueError(
+            "multi-host data axis does not give each process one equal "
+            f"contiguous block: data-axis rows map to processes {rows}; "
+            "order the mesh devices so every process owns "
+            "n_data/n_processes consecutive rows (jax's default device "
+            "order does this)"
+        )
+
+
 def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Shard dim 0 (batch) over ``data``; everything else replicated."""
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))

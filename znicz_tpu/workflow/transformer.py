@@ -332,10 +332,23 @@ class TransformerLMWorkflow(Workflow):
             if pipeline_microbatches:
                 self.pipeline_microbatches = pipeline_microbatches
             else:
+                # under DPxPP the microbatch rows must also split over the
+                # data axis, so the search wants bs % m == 0 AND
+                # (bs // m) % n_data == 0 — m=1 always satisfies both
+                # (multi-host/DP already require n_data | bs)
                 bs = loader.max_minibatch_size
+                n_data = (
+                    self.parallel.n_data if self.parallel is not None else 1
+                )
                 m = min(6 * self._n_stages, bs)
-                while m > 1 and bs % m:
+                while m > 1 and (bs % m or (bs // m) % n_data):
                     m -= 1
+                if bs % m or (bs // m) % n_data:
+                    raise ValueError(
+                        f"no pipeline microbatch count divides batch {bs} "
+                        f"into data-axis-{n_data}-divisible microbatches; "
+                        "choose minibatch_size as a multiple of n_data"
+                    )
                 self.pipeline_microbatches = m
         if tensor_parallel:
             from znicz_tpu.parallel import DataParallel
