@@ -145,6 +145,7 @@ def pipeline_apply(
     n_microbatches: int,
     axis: str = PIPE_AXIS,
     data_axis: str = None,
+    param_spec_fn=None,
     check_vma: bool = True,
 ) -> jnp.ndarray:
     """Run x [B, F] through the stacked stages, pipelined over ``mesh[axis]``.
@@ -159,6 +160,13 @@ def pipeline_apply(
     pipeline over its batch shard (stage params replicate across ``data``;
     shard_map's transpose psums their grads over it automatically).  Real
     pipelines ride a (data, pipe) mesh — GPipe without DP is a demo.
+
+    ``param_spec_fn``: optional ``(path_str, stacked_leaf) -> PartitionSpec``
+    overriding the default P(pipe, None, ...) placement — the PPxTP hook:
+    specs may shard weight dims over the ``model`` axis, in which case
+    ``apply_one`` sees model-LOCAL stage weights and must contract locally
+    + psum over that axis itself (Megatron row/column style).  Activations
+    stay replicated over ``model``.
     """
     n_stages = mesh.shape[axis]
     if data_axis is not None:
@@ -196,7 +204,15 @@ def pipeline_apply(
     def spec_for(leaf):
         return P(axis, *([None] * (leaf.ndim - 1)))
 
-    param_specs = jax.tree_util.tree_map(spec_for, stacked_params)
+    if param_spec_fn is None:
+        param_specs = jax.tree_util.tree_map(spec_for, stacked_params)
+    else:
+        param_specs = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: param_spec_fn(
+                jax.tree_util.keystr(path), leaf
+            ),
+            stacked_params,
+        )
     # microbatch STORE sharded chunk-per-device over pipe; under DP the
     # row dim additionally shards over data (independent pipeline per
     # data replica)
@@ -230,6 +246,7 @@ def pipelined_model_apply(
     n_microbatches: int,
     axis: str = PIPE_AXIS,
     data_axis: str = None,
+    param_spec_fn=None,
     check_vma: bool = True,
 ) -> jnp.ndarray:
     """Embed -> pipelined tower -> head: the real-model decomposition
@@ -241,7 +258,7 @@ def pipelined_model_apply(
         params["stages"], h,
         apply_one=stage_fn, mesh=mesh,
         n_microbatches=n_microbatches, axis=axis, data_axis=data_axis,
-        check_vma=check_vma,
+        param_spec_fn=param_spec_fn, check_vma=check_vma,
     )
     return head_fn(params["head"], h)
 

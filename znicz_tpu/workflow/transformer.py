@@ -14,6 +14,7 @@ HyperParams and ``*_bias`` multiplier rules apply unchanged.
 
 from __future__ import annotations
 
+import re
 from functools import partial
 from typing import Optional
 
@@ -99,6 +100,35 @@ def _block_forward(block, x, *, n_heads, attention_fn=None):
     return x + h @ block["w_down"] + block["down_bias"]
 
 
+def _block_forward_tp(block, x, *, n_heads_local, tp_axis, attention_fn=None):
+    """:func:`_block_forward` for MANUAL (shard_map) tensor parallelism:
+    the block's weights are model-axis-LOCAL shards (Megatron column
+    placement for wq/wk/wv/w_up — so this device owns ``n_heads_local``
+    heads and a 1/mp slice of the FFN — row placement for wo/w_down), and
+    the two residual contributions are partial products ``psum``-ed over
+    ``tp_axis``.  Activations enter and leave replicated over the model
+    axis; same math as :func:`_block_forward` up to summation order.
+    Used inside the pipeline's shard_map, where GSPMD cannot insert the
+    collectives for us (SURVEY.md 2.5 beyond-parity: PPxTPxDP)."""
+    attention_fn = attention_fn or attention.dot_product_attention
+    h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
+    b, t, _ = h.shape
+
+    def proj(w):
+        y = jnp.dot(h, w, preferred_element_type=jnp.float32).astype(h.dtype)
+        return y.reshape(b, t, n_heads_local, -1)
+
+    q, k, v = proj(block["wq"]), proj(block["wk"]), proj(block["wv"])
+    o = attention_fn(q, k, v, causal=True).reshape(b, t, -1)
+    att = jnp.dot(
+        o, block["wo"], preferred_element_type=jnp.float32
+    ).astype(h.dtype)
+    x = x + jax.lax.psum(att, tp_axis)
+    h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
+    h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
+    return x + jax.lax.psum(h @ block["w_down"], tp_axis) + block["down_bias"]
+
+
 def lm_apply(params, tokens, *, n_heads, attention_fn=None, remat=False):
     """tokens [B, T] int32 -> logits [B, T, vocab].
 
@@ -142,17 +172,37 @@ def stack_lm_blocks(params, n_stages: int):
 
 def lm_apply_pipelined(
     params_pp, tokens, *, n_heads, mesh, n_microbatches,
-    data_axis=None, attention_fn=None, remat=False,
+    data_axis=None, tp_axis=None, attention_fn=None, remat=False,
 ):
     """tokens [B, T] -> logits, with the block tower pipelined over the
     mesh's ``pipe`` axis (embed/head run outside the shard_map);
-    ``data_axis`` shards microbatch rows for DPxPP composition."""
+    ``data_axis`` shards microbatch rows for DPxPP composition;
+    ``tp_axis`` additionally shards each stage's weights over the model
+    axis (Megatron column/row inside the pipeline shard_map — the 3-axis
+    DPxPPxTP composition)."""
     from znicz_tpu.parallel.pipeline import pipelined_model_apply
 
     def embed_fn(p, tok):
         return _embed_tokens(p, tok)
 
-    blk = partial(_block_forward, n_heads=n_heads, attention_fn=attention_fn)
+    param_spec_fn = None
+    if tp_axis is not None:
+        n_model = mesh.shape[tp_axis]
+        if n_heads % n_model:
+            raise ValueError(
+                f"n_heads={n_heads} not divisible by model axis {n_model}"
+            )
+        blk = partial(
+            _block_forward_tp,
+            n_heads_local=n_heads // n_model,
+            tp_axis=tp_axis,
+            attention_fn=attention_fn,
+        )
+        param_spec_fn = _pp_stage_tp_specs(tp_axis)
+    else:
+        blk = partial(
+            _block_forward, n_heads=n_heads, attention_fn=attention_fn
+        )
     if remat:  # recompute per-block activations in the backward pipeline
         blk = jax.checkpoint(blk)
 
@@ -168,6 +218,7 @@ def lm_apply_pipelined(
         params_pp, tokens,
         embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
         mesh=mesh, n_microbatches=n_microbatches, data_axis=data_axis,
+        param_spec_fn=param_spec_fn,
         # flash attention inside the stage is a pallas_call: no vma
         # annotation on its out_shapes, so the check must be off for it
         check_vma=attention_fn is None,
@@ -183,6 +234,55 @@ def lm_pp_rules(path: str, leaf):
 
     if "'stages'" in path:
         return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
+    return P()
+
+
+def _stage_tp_spec(key: str, ndim: int, tp_axis: str = MODEL_AXIS):
+    """PartitionSpec for ONE stacked stage leaf [S, ...] under PPxTP:
+    stage dim over ``pipe``, weight dims per the Megatron role
+    (column: wq/wk/wv/w_up + up_bias; row: wo/w_down; rest replicated
+    over ``tp_axis``)."""
+    from jax.sharding import PartitionSpec as P
+
+    from znicz_tpu.parallel.mesh import PIPE_AXIS
+
+    if key in ("wq", "wk", "wv", "w_up"):
+        return P(PIPE_AXIS, None, tp_axis)
+    if key in ("wo", "w_down"):
+        return P(PIPE_AXIS, tp_axis, None)
+    if key == "up_bias":
+        return P(PIPE_AXIS, tp_axis)
+    return P(PIPE_AXIS, *([None] * (ndim - 1)))
+
+
+_KEY_PAT = re.compile(r"\['(\w+)'\]")
+
+
+def _last_key(path: str) -> str:
+    """Last ['name'] component of a jax keystr path."""
+    keys = _KEY_PAT.findall(path)
+    return keys[-1] if keys else ""
+
+
+def _pp_stage_tp_specs(tp_axis):
+    """pipeline_apply ``param_spec_fn`` for the LM stage tower under TP
+    (weight placement and the psums in :func:`_block_forward_tp` use the
+    SAME axis)."""
+
+    def spec_fn(path: str, leaf):
+        return _stage_tp_spec(_last_key(path), leaf.ndim, tp_axis)
+
+    return spec_fn
+
+
+def lm_pp_tp_rules(path: str, leaf):
+    """DataParallel param_rules for the PPxTP LM: stacked stage weights
+    shard over (pipe, model) per their Megatron role; embed/head
+    replicate (they run outside the pipeline shard_map)."""
+    from jax.sharding import PartitionSpec as P
+
+    if "'stages'" in path:
+        return _stage_tp_spec(_last_key(path), leaf.ndim)
     return P()
 
 
@@ -229,8 +329,13 @@ class TransformerLMWorkflow(Workflow):
     batch shard and stage grads all-reduce over ``data``.  Stage params
     live chunk-per-device; embed/head run outside the pipeline.
     ``pipeline_microbatches`` defaults to ``6 * n_stages`` (GPipe bubble
-    < 0.15 for every stage count).  Mutually exclusive with
-    sequence/tensor parallel.
+    < 0.15 for every stage count), clamped to the largest count compatible
+    with the batch size and data axis — a warning fires when the clamp
+    leaves a larger bubble.  Composes with ``tensor_parallel`` on a
+    (data, pipe, model) mesh: each stage's weights shard over ``model``
+    inside the pipeline shard_map (Megatron column/row with explicit
+    psums — :func:`_block_forward_tp`).  Mutually exclusive with
+    sequence parallel.
     """
 
     def __init__(
@@ -292,14 +397,15 @@ class TransformerLMWorkflow(Workflow):
         if pipeline_parallel:
             from znicz_tpu.parallel.mesh import PIPE_AXIS
 
-            if sequence_parallel or tensor_parallel:
+            if sequence_parallel:
                 raise ValueError(
                     "pipeline_parallel is mutually exclusive with "
-                    "sequence/tensor parallel (one mesh axis per workflow)"
+                    "sequence parallel (both want to own the batch layout)"
                 )
             if parallel is not None:
-                # DPxPP: batch over data, stages over pipe, on ONE mesh —
-                # the placement policy's mesh is the pipeline's mesh
+                # DPxPP(xTP): batch over data, stages over pipe (weights
+                # additionally over model under TP), on ONE mesh — the
+                # placement policy's mesh is the pipeline's mesh
                 if mesh is not None and mesh != parallel.mesh:
                     raise ValueError(
                         "pipeline_parallel with parallel=DataParallel: "
@@ -311,12 +417,33 @@ class TransformerLMWorkflow(Workflow):
 
                 if self.parallel.param_rules is None:
                     self.parallel = DataParallel(
-                        parallel.mesh, param_rules=lm_pp_rules
+                        parallel.mesh,
+                        param_rules=(
+                            lm_pp_tp_rules if tensor_parallel else lm_pp_rules
+                        ),
                     )
             if mesh is None or PIPE_AXIS not in mesh.shape:
                 raise ValueError(
                     "pipeline_parallel=True needs a mesh with a 'pipe' axis"
                 )
+            if tensor_parallel:
+                n_model = mesh.shape.get(MODEL_AXIS, 1)
+                if n_model <= 1:
+                    raise ValueError(
+                        "pipeline+tensor parallel needs a mesh with a "
+                        "'model' axis > 1"
+                    )
+                if n_heads % n_model:
+                    raise ValueError(
+                        f"n_heads={n_heads} not divisible by model axis "
+                        f"{n_model}"
+                    )
+                if self.parallel is None:
+                    raise ValueError(
+                        "pipeline+tensor parallel needs parallel="
+                        "DataParallel over the (data, pipe, model) mesh "
+                        "(stage weight placement rides its param_rules)"
+                    )
             self._n_stages = mesh.shape[PIPE_AXIS]
             if n_layers % self._n_stages:
                 raise ValueError(
@@ -350,7 +477,19 @@ class TransformerLMWorkflow(Workflow):
                         "choose minibatch_size as a multiple of n_data"
                     )
                 self.pipeline_microbatches = m
-        if tensor_parallel:
+                from znicz_tpu.parallel.pipeline import bubble_fraction
+
+                bubble = bubble_fraction(self._n_stages, m)
+                if bubble > 0.16:  # the documented default bound
+                    self.warning(
+                        "auto-selected %d pipeline microbatches (batch %d, "
+                        "data axis %d) leaves a GPipe bubble of %.0f%%; "
+                        "raise minibatch_size toward %d*n_data to recover "
+                        "pipeline efficiency",
+                        m, bs, n_data, 100 * bubble,
+                        6 * self._n_stages,
+                    )
+        if tensor_parallel and not pipeline_parallel:
             from znicz_tpu.parallel import DataParallel
 
             if not isinstance(self.parallel, DataParallel):
@@ -453,6 +592,7 @@ class TransformerLMWorkflow(Workflow):
                 mesh=self.mesh,
                 n_microbatches=self.pipeline_microbatches,
                 data_axis=DATA_AXIS if self.parallel is not None else None,
+                tp_axis=MODEL_AXIS if self.tensor_parallel else None,
                 attention_fn=attention_fn,
                 remat=self.remat,
             )
