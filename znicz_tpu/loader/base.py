@@ -91,6 +91,27 @@ class Loader:
         embed into the compiled executable."""
         return None
 
+    # data-axis pool sharding (loaders that support it set this True and
+    # implement set_data_shards; Workflow.initialize calls it with the
+    # mesh's data-axis size before placing the device context)
+    wants_data_shards = False
+
+    def set_data_shards(self, n: int) -> None:
+        raise NotImplementedError
+
+    def place_device_context(self, parallel):
+        """Device-place :meth:`device_context` (None when there is none).
+        Default: fully replicated over the mesh — loaders whose context is
+        sharded (e.g. the data-axis-sharded pool) override this."""
+        ctx = self.device_context()
+        if ctx is None:
+            return None
+        import jax
+
+        if parallel is not None:
+            return jax.tree_util.tree_map(parallel.put_replicated, ctx)
+        return jax.tree_util.tree_map(jax.device_put, ctx)
+
     def set_process_shard(self, index: int, count: int) -> None:
         """Multi-host sample sharding (the reference's job-assignment
         semantics, SURVEY.md 3.4: the master handed each slave an index
@@ -178,10 +199,16 @@ class Loader:
                 idx = np.concatenate([idx, pad])
             mask = np.zeros(bs, np.float32)
             mask[:n_valid] = 1.0
+            self._validate_batch_indices(idx, split)
             if self.process_count > 1:
                 idx, mask = idx[lo:hi], mask[lo:hi]
             mb = self.fill(idx, split)
             yield mb._replace(mask=mask, indices=idx)
+
+    def _validate_batch_indices(self, idx: np.ndarray, split: str) -> None:
+        """Hook: loaders with placement invariants on the FULL (pre-
+        process-slice) batch index layout check them here (e.g. the
+        pool-sharded alignment of batch blocks to data-axis shards)."""
 
     def epoch(self) -> Iterator[tuple]:
         """One full epoch: train batches then valid then test, tagged."""

@@ -112,17 +112,13 @@ def _block_forward_tp(block, x, *, n_heads_local, tp_axis, attention_fn=None):
     collectives for us (SURVEY.md 2.5 beyond-parity: PPxTPxDP)."""
     attention_fn = attention_fn or attention.dot_product_attention
     h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
-    b, t, _ = h.shape
-
-    def proj(w):
-        y = jnp.dot(h, w, preferred_element_type=jnp.float32).astype(h.dtype)
-        return y.reshape(b, t, n_heads_local, -1)
-
-    q, k, v = proj(block["wq"]), proj(block["wk"]), proj(block["wv"])
-    o = attention_fn(q, k, v, causal=True).reshape(b, t, -1)
-    att = jnp.dot(
-        o, block["wo"], preferred_element_type=jnp.float32
-    ).astype(h.dtype)
+    # mha over the LOCAL head subset computes exactly the partial product
+    # o @ wo_local this device owes the psum (one mha definition — same
+    # no-drift rationale as _block_forward)
+    att = attention.mha(
+        block, h, n_heads=n_heads_local, causal=True,
+        attention_fn=attention_fn,
+    )
     x = x + jax.lax.psum(att, tp_axis)
     h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
     h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
@@ -433,6 +429,16 @@ class TransformerLMWorkflow(Workflow):
                         "pipeline+tensor parallel needs a mesh with a "
                         "'model' axis > 1"
                     )
+                if attention == "flash":
+                    # flash under PPxTP would run the model-axis param
+                    # sharding with check_vma=False (pallas out_shapes
+                    # carry no vma info) — a gradient path with the
+                    # replication checks off that no test validates yet
+                    raise ValueError(
+                        "attention='flash' is not yet validated under "
+                        "pipeline+tensor parallel; use attention='dot' "
+                        "(or 'auto', which selects dot here)"
+                    )
                 if n_heads % n_model:
                     raise ValueError(
                         f"n_heads={n_heads} not divisible by model axis "
@@ -563,6 +569,10 @@ class TransformerLMWorkflow(Workflow):
                 else "dense"
             )
             return partial(ring_attention, mesh=self.mesh, inner=inner)
+        if self.pipeline_parallel and self.tensor_parallel:
+            # flash under PPxTP is rejected in __init__; auto selects the
+            # dense kernel here until that gradient path is validated
+            return None
         # blockwise flash kernel (ops/pallas/attention.py): O(T·D) memory
         # and VMEM-resident online softmax — the long-context default on
         # TPU once the quadratic score matrix stops being a rounding error

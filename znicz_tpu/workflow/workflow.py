@@ -385,12 +385,19 @@ class Workflow(Logger):
         # host-side mirror of state.step: lr policies read it every minibatch
         # and must not force a device sync in the hot loop
         self._host_step = int(self.state.step)
+        # data-axis pool sharding: the loader partitions its dataset over
+        # the mesh's data axis (each device holds 1/D of the rows), so the
+        # HBM capacity ceiling scales with the mesh instead of one chip
+        if self.loader.wants_data_shards:
+            if self.parallel is None:
+                raise ValueError(
+                    "this loader shards its device pool over the data "
+                    "axis; pass parallel=DataParallel(mesh)"
+                )
+            self.loader.set_data_shards(self.parallel.n_data)
         # loader-owned device context (e.g. HBM-resident dataset pool):
         # ONE up-front transfer, threaded through every step as an argument
-        ctx_host = self.loader.device_context()
-        self._ctx = (
-            None if ctx_host is None else self._put_replicated(ctx_host)
-        )
+        self._ctx = self.loader.place_device_context(self.parallel)
         self._build_steps()
 
     def _batch_target(self, mb):
