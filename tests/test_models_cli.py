@@ -202,6 +202,18 @@ class TestLauncherCLI:
         )
         assert launcher.result.epoch == 1
 
+    def test_epoch_sync_flag(self, tmp_path):
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        launcher = run_args(
+            [str(wf_py), "--random-seed", "1", "--stop-after", "2",
+             "--epoch-sync", "deferred"]
+        )
+        assert launcher.workflow.epoch_sync == "deferred"
+        assert launcher.result.epoch == 2  # exact stop despite the lag
+
     def test_dry_run(self, tmp_path):
         wf_py = tmp_path / "wf.py"
         wf_py.write_text(

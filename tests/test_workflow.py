@@ -125,6 +125,79 @@ class TestEpochScanDispatch:
             assert ea["train"]["n_err"] == eb["train"]["n_err"]
 
 
+class TestDeferredEpochSync:
+    """epoch_sync='deferred': the metric fetch of epoch N overlaps epoch
+    N+1's dispatch.  History and stopping must be IDENTICAL to sync mode —
+    only the reporting lags."""
+
+    def _build(self, epoch_sync, *, max_epochs=4, fail_iterations=100,
+               seed=81):
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+        prng.seed_all(seed)
+        gen = np.random.default_rng(19)
+        images = gen.integers(0, 256, (96, 8, 8, 1), dtype=np.uint8)
+        labels = (images.mean(axis=(1, 2, 3)) > 127).astype(np.int32)
+        loader = FullBatchLoader(
+            {"train": images}, {"train": labels}, minibatch_size=32,
+            normalization="range",
+            normalization_kwargs={"scale": 255.0, "shift": -0.5},
+            device_resident=True,
+        )
+        wf = StandardWorkflow(
+            loader,
+            [{"type": "all2all_tanh", "->": {"output_sample_shape": 8}},
+             {"type": "softmax", "->": {"output_sample_shape": 2}}],
+            decision_config={"max_epochs": max_epochs,
+                             "fail_iterations": fail_iterations},
+            default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+            epoch_sync=epoch_sync,
+        )
+        wf.initialize(seed=seed)
+        return wf
+
+    def test_matches_sync_history_and_stop(self):
+        a = self._build("sync").run()
+        b = self._build("deferred").run()
+        assert len(a.history) == len(b.history) == 4  # exact stop
+        for ea, eb in zip(a.history, b.history):
+            np.testing.assert_allclose(
+                ea["train"]["loss"], eb["train"]["loss"],
+                rtol=1e-6, atol=1e-8,
+            )
+
+    def test_patience_stop_is_exact(self):
+        # fail_iterations-driven stop: deferred must not run extra epochs
+        da = self._build(
+            "sync", max_epochs=50, fail_iterations=2, seed=83
+        ).run()
+        db = self._build(
+            "deferred", max_epochs=50, fail_iterations=2, seed=83
+        ).run()
+        assert len(da.history) == len(db.history)
+        assert da.best_epoch == db.best_epoch
+
+    def test_run_epoch_lags_one_verdict(self):
+        wf = self._build("deferred")
+        assert wf.run_epoch() is None  # epoch 0 dispatched, nothing done
+        v0 = wf.run_epoch()  # epoch 1 dispatched, epoch 0 reported
+        assert v0 is not None and not v0["stop"]
+        assert wf.decision.epoch == 1
+        final = wf.sync_epoch()  # flush epoch 1
+        assert final is not None
+        assert wf.sync_epoch() is None  # idempotent
+
+    def test_snapshotter_rejected(self):
+        from znicz_tpu.workflow.snapshotter import Snapshotter
+
+        with np.testing.assert_raises(ValueError):
+            Workflow(
+                loader=None, model=None,
+                snapshotter=Snapshotter("/tmp/x"),
+                epoch_sync="deferred",
+            )
+
+
 class TestModelBuilder:
     def test_mlp_shapes(self):
         m = build(MLP_LAYERS, (784,))

@@ -95,6 +95,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "evaluation pass over SPLIT (default: test) with the "
                         "confusion matrix, print a JSON summary and exit "
                         "without training")
+    p.add_argument("--epoch-sync", default=None,
+                   choices=["sync", "deferred"],
+                   help="deferred: overlap the per-epoch metric fetch with "
+                        "the next epoch's dispatch (verdicts lag one epoch; "
+                        "stop decisions stay exact; incompatible with a "
+                        "snapshotter)")
     p.add_argument("--dry-run", action="store_true",
                    help="build and initialize the workflow, run nothing")
     p.add_argument("--verbose", action="store_true")
@@ -114,6 +120,11 @@ class Launcher(Logger):
         """Construct the workflow, applying CLI overrides."""
         if self.args.snapshot_dir and "snapshot_dir" not in wf_kwargs:
             wf_kwargs["snapshot_dir"] = self.args.snapshot_dir
+        if (
+            getattr(self.args, "epoch_sync", None)
+            and "epoch_sync" not in wf_kwargs
+        ):
+            wf_kwargs["epoch_sync"] = self.args.epoch_sync
         if self.args.stop_after is not None:
             dc = dict(wf_kwargs.get("decision_config") or {})
             dc["max_epochs"] = self.args.stop_after

@@ -120,9 +120,7 @@ class Decision:
             improved = True
         else:
             self.epochs_since_best += 1
-        stop = (
-            self.max_epochs is not None and self.epoch + 1 >= self.max_epochs
-        ) or (self.epochs_since_best >= self.fail_iterations)
+        stop = self._would_stop(self.epoch, self.epochs_since_best)
         self._current = {}
         self.epoch += 1
         return {
@@ -132,6 +130,22 @@ class Decision:
             "best_value": self.best_value,
             "best_epoch": self.best_epoch,
         }
+
+    def _would_stop(self, epoch: int, epochs_since_best: int) -> bool:
+        """THE stop predicate — on_epoch_end and can_stop_next_epoch must
+        share it, or deferred epoch sync's exactness silently breaks when
+        a stop condition is added to one but not the other."""
+        return (
+            self.max_epochs is not None and epoch + 1 >= self.max_epochs
+        ) or (epochs_since_best >= self.fail_iterations)
+
+    def can_stop_next_epoch(self) -> bool:
+        """Whether the NEXT ``on_epoch_end`` could possibly return
+        ``stop=True``, for ANY metric values (worst case: no improvement).
+        Drives the workflow's deferred epoch sync: an epoch whose verdict
+        provably cannot stop may be reported one epoch late without
+        changing when training ends."""
+        return self._would_stop(self.epoch, self.epochs_since_best + 1)
 
     # -- checkpointable state (host side of snapshot/resume, SURVEY.md 3.5) --
     def state_dict(self) -> Dict[str, object]:

@@ -52,6 +52,7 @@ class StandardWorkflow(Workflow):
         prefetch_batches: int = 2,
         parallel=None,
         epoch_dispatch: str = "auto",
+        epoch_sync: str = "sync",
         rand_name: str = "default",
         name: str = "StandardWorkflow",
     ):
@@ -95,6 +96,7 @@ class StandardWorkflow(Workflow):
             prefetch_batches=prefetch_batches,
             parallel=parallel,
             epoch_dispatch=epoch_dispatch,
+            epoch_sync=epoch_sync,
             name=name,
         )
 

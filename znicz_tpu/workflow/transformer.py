@@ -356,6 +356,7 @@ class TransformerLMWorkflow(Workflow):
         lr_policy=None,
         parallel=None,
         prefetch_batches: int = 2,
+        epoch_sync: str = "sync",
         rand_name: str = "default",
         name: str = "TransformerLMWorkflow",
     ):
@@ -373,6 +374,7 @@ class TransformerLMWorkflow(Workflow):
             lr_policy=lr_policy,
             parallel=parallel,
             prefetch_batches=prefetch_batches,
+            epoch_sync=epoch_sync,
             name=name,
         )
         self.vocab = vocab

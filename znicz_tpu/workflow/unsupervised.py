@@ -53,6 +53,7 @@ class KohonenWorkflow(Workflow):
         snapshotter: Optional[Snapshotter] = None,
         parallel=None,
         prefetch_batches: int = 2,
+        epoch_sync: str = "sync",
         rand_name: str = "default",
         impl: str = "auto",  # "pallas" | "xla" | "auto" (pallas on TPU)
         name: str = "KohonenWorkflow",
@@ -67,6 +68,7 @@ class KohonenWorkflow(Workflow):
             snapshotter=snapshotter,
             parallel=parallel,
             prefetch_batches=prefetch_batches,
+            epoch_sync=epoch_sync,
             name=name,
         )
         self.sx, self.sy = sx, sy
@@ -180,6 +182,7 @@ class RBMWorkflow(Workflow):
         snapshotter: Optional[Snapshotter] = None,
         parallel=None,
         prefetch_batches: int = 2,
+        epoch_sync: str = "sync",
         rand_name: str = "default",
         impl: str = "auto",  # "pallas" | "xla" | "auto" (pallas on TPU)
         name: str = "RBMWorkflow",
@@ -193,6 +196,7 @@ class RBMWorkflow(Workflow):
             snapshotter=snapshotter,
             parallel=parallel,
             prefetch_batches=prefetch_batches,
+            epoch_sync=epoch_sync,
             name=name,
         )
         self.n_hidden = n_hidden

@@ -85,6 +85,7 @@ class Workflow(Logger):
         parallel=None,
         prefetch_batches: int = 2,
         epoch_dispatch: str = "auto",  # "auto" | "scan" | "step"
+        epoch_sync: str = "sync",  # "sync" | "deferred"
         name: str = "workflow",
     ):
         self.loader = loader
@@ -104,6 +105,20 @@ class Workflow(Logger):
                 "want 'auto', 'scan' or 'step'"
             )
         self.epoch_dispatch = epoch_dispatch
+        if epoch_sync not in ("sync", "deferred"):
+            raise ValueError(
+                f"epoch_sync={epoch_sync!r}: want 'sync' or 'deferred'"
+            )
+        if epoch_sync == "deferred" and snapshotter is not None:
+            # a deferred epoch's snapshot would capture the NEXT epoch's
+            # params — the lag is fine for metrics, wrong for state
+            raise ValueError(
+                "epoch_sync='deferred' is incompatible with a snapshotter "
+                "(the state to snapshot has already advanced when the "
+                "lagged verdict arrives); use epoch_sync='sync'"
+            )
+        self.epoch_sync = epoch_sync
+        self._pending_accs = None
         self.services = []  # per-epoch observers: plotters, status, image saver
         self.name = name
         self.state: Optional[TrainState] = None
@@ -496,13 +511,55 @@ class Workflow(Logger):
                 accs[split] = acc
         return accs
 
-    def run_epoch(self) -> Dict[str, Any]:
-        """One full epoch over all splits; returns the Decision verdict."""
+    def run_epoch(self) -> Optional[Dict[str, Any]]:
+        """One full epoch over all splits; returns the Decision verdict.
+
+        ``epoch_sync="deferred"``: the device->host metric fetch of epoch N
+        overlaps epoch N+1's dispatch, so the per-epoch transport round
+        trip drops out of the wall clock.  The returned verdict then lags
+        one epoch (None on the very first call); stop decisions stay
+        EXACT — when the Decision could possibly stop on the pending
+        epoch, it is flushed synchronously before anything new dispatches.
+        """
         if self.state is None:
             self.initialize()
-        if self._use_epoch_scan():
-            accs = self._run_epoch_scanned()
+        deferred = self.epoch_sync == "deferred"
+        flushed = None
+        if (
+            deferred
+            and self._pending_accs is not None
+            and self.decision.can_stop_next_epoch()
+        ):
+            accs, self._pending_accs = self._pending_accs, None
+            flushed = self._finish_epoch(accs)
+            if flushed["stop"]:
+                return flushed  # nothing new dispatched
+        accs = (
+            self._run_epoch_scanned()
+            if self._use_epoch_scan()
+            else self._run_epoch_stepwise()
+        )
+        if not deferred:
             return self._finish_epoch(accs)
+        for acc in accs.values():  # start the copies behind the dispatch
+            if hasattr(acc, "copy_to_host_async"):
+                acc.copy_to_host_async()
+        prev, self._pending_accs = self._pending_accs, accs
+        if prev is not None:
+            # guard above guarantees this verdict cannot be a stop
+            return self._finish_epoch(prev)
+        return flushed
+
+    def sync_epoch(self) -> Optional[Dict[str, Any]]:
+        """Flush a deferred epoch's metrics (no-op returning None when
+        nothing is pending).  Call after a ``run_epoch`` loop in deferred
+        mode to observe the final epoch."""
+        if self._pending_accs is None:
+            return None
+        accs, self._pending_accs = self._pending_accs, None
+        return self._finish_epoch(accs)
+
+    def _run_epoch_stepwise(self) -> Dict[str, jax.Array]:
         accs: Dict[str, jax.Array] = {}  # per-split on-device accumulators
         put = (
             self.parallel.shard_batch if self.parallel is not None else jnp.asarray
@@ -548,7 +605,7 @@ class Workflow(Logger):
                         self.state.params, x, y, mask, acc, self._ctx
                     )
                 accs[split] = acc
-        return self._finish_epoch(accs)
+        return accs
 
     def _finish_epoch(self, accs: Dict[str, jax.Array]) -> Dict[str, Any]:
         with self.timer.phase("metrics_sync"):
@@ -646,6 +703,8 @@ class Workflow(Logger):
         t0 = time.time()
         while True:
             verdict = self.run_epoch()
+            if verdict is None:  # deferred sync: no completed epoch yet
+                continue
             s = verdict["summary"]
             parts = [
                 f"{split} err={m['err_pct']:.2f}% loss={m['loss']:.4f}"
