@@ -21,9 +21,10 @@ from znicz_tpu.loader.base import (
     pool_concat,
     pool_offsets,
 )
+from znicz_tpu.loader.pool_sharded import PoolShardedMixin
 
 
-class FullBatchLoader(Loader):
+class FullBatchLoader(PoolShardedMixin, Loader):
     """Serve minibatches from per-split in-memory arrays.
 
     ``data[split]``: [n, ...] float array; ``labels[split]``: [n] ints or
@@ -100,30 +101,13 @@ class FullBatchLoader(Loader):
         self._pool_offsets: Dict[str, int] = (
             pool_offsets(self.data) if device_resident else {}
         )
-        # pool_sharded: the HBM pool shards over the mesh's DATA axis —
-        # each device holds 1/D of every split, so dataset capacity is
-        # D x one chip's free HBM instead of one chip's (max rows ~=
-        # n_data * HBM_free / bytes_per_sample).  Locality is by
-        # construction: sampling is per-shard (batch position block s only
-        # draws from shard s's rows — see set_data_shards), payloads are
-        # LOCAL pool addresses, and the gather runs inside a shard_map, so
-        # no collective ever touches pool-sized data.  Epoch semantics:
-        # every sample still appears exactly once per epoch; minibatch
-        # COMPOSITION differs from the global shuffle (each 1/D batch
-        # block mixes only within its shard).
+        # pool_sharded: shard the HBM pool over the mesh's DATA axis
+        # (capacity = n_data x one chip's HBM, per-shard sampling, local
+        # shard_map gathers — loader/pool_sharded.py has the full story)
         if pool_sharded and not device_resident:
             raise ValueError("pool_sharded=True requires device_resident")
-        if pool_sharded and self.balanced:
-            raise ValueError(
-                "pool_sharded is incompatible with balanced=True (the "
-                "class-balanced shuffle is a global permutation; per-shard "
-                "sampling owns the batch layout)"
-            )
-        self._pool_sharded = pool_sharded
         self.wants_data_shards = pool_sharded
-        self.data_shards = 1
         self._mesh = None
-        self._local_split_offset: Dict[str, int] = {}
         if not self._lazy_u8:
             # Normalize each immutable split ONCE here, not per minibatch.
             self.data = {
@@ -134,126 +118,14 @@ class FullBatchLoader(Loader):
                 for split, raw in self.data.items()
             }
 
-    # -- data-axis pool sharding -------------------------------------------
-    def set_data_shards(self, n: int) -> None:
-        """Partition every split into ``n`` equal row blocks (shard s of a
-        split owns rows [s*len/n, (s+1)*len/n)); sampling becomes
-        per-shard so batch position block s only references shard s."""
-        bs = self.max_minibatch_size
-        if bs % n:
-            raise ValueError(
-                f"pool_sharded: minibatch_size {bs} not divisible by the "
-                f"data axis {n}"
-            )
-        for split, arr in self.data.items():
-            if len(arr) % bs:
-                raise ValueError(
-                    f"pool_sharded: split {split!r} has {len(arr)} rows, "
-                    f"not a multiple of minibatch_size {bs} (static equal "
-                    "per-shard chunks need full batches; pad or trim the "
-                    "split)"
-                )
-        self.data_shards = int(n)
-        self._order.clear()  # orders must be rebuilt in blocked layout
-        # per-device block layout = the SHARED pool ordering contract
-        # applied to one shard's chunk of each split
-        self._local_split_offset = pool_offsets(
-            {s: arr[: len(arr) // n] for s, arr in self.data.items()}
-        )
-
-    def _blocked_order(self, per_shard_rows) -> np.ndarray:
-        """[D, c] per-shard row ids -> epoch order where batch b's position
-        block s holds shard s's rows [b*B/D, (b+1)*B/D)."""
-        d, c = per_shard_rows.shape
-        rows_per = self.max_minibatch_size // d
-        steps = c // rows_per
-        return (
-            per_shard_rows.reshape(d, steps, rows_per)
-            .transpose(1, 0, 2)
-            .reshape(-1)
-        )
-
-    def _split_order(self, split: str) -> np.ndarray:
-        if self.data_shards <= 1:
-            return super()._split_order(split)
-        n = self.class_lengths[split]
-        order = self._order.get(split)
-        if order is None or len(order) != n:
-            c = n // self.data_shards
-            order = self._blocked_order(
-                np.arange(n).reshape(self.data_shards, c)
-            )
-            self._order[split] = order
-        return order
-
-    def reshuffle(self, split: str = "train") -> None:
-        if self.data_shards <= 1:
-            return super().reshuffle(split)
-        n = self.class_lengths.get(split, 0)
-        if not n:
-            return
-        from znicz_tpu.core import prng
-
-        gen = prng.get(self.rand_name)
-        c = n // self.data_shards
-        per_shard = np.stack(
-            [s * c + gen.permutation(c) for s in range(self.data_shards)]
-        )
-        self._order[split] = self._blocked_order(per_shard)
-
-    def _validate_batch_indices(self, idx: np.ndarray, split: str) -> None:
-        if self.data_shards <= 1:
-            return
-        c = self.class_lengths[split] // self.data_shards
-        rows_per = len(idx) // self.data_shards
-        expected = np.repeat(np.arange(self.data_shards), rows_per)
-        if not np.array_equal(idx // c, expected):
-            raise AssertionError(
-                "pool_sharded alignment violated: batch position block s "
-                "must only reference data-axis shard s (a local gather "
-                "would silently fetch wrong rows)"
-            )
-
-    def place_device_context(self, parallel):
-        if not self._pool_sharded:
-            return super().place_device_context(parallel)
-        if parallel is None:
-            raise ValueError(
-                "pool_sharded=True needs parallel=DataParallel(mesh)"
-            )
-        if self.data_shards != parallel.n_data:
-            raise ValueError(
-                f"pool_sharded: set_data_shards({parallel.n_data}) was not "
-                f"applied (have {self.data_shards}); initialize the "
-                "workflow instead of placing the context by hand"
-            )
-        self._mesh = parallel.mesh
-        # shard the pool rows over the data axis: this process ships ONLY
-        # its shards' rows; shard_batch assembles the global array
-        # (make_array_from_process_local_data on multi-host)
-        return {"pool": parallel.shard_batch(self._local_pool())}
-
-    def _local_pool(self) -> np.ndarray:
-        """Shard-major pool rows owned by THIS process: for each of its
-        data-axis shards, each split's chunk in the shared pool order."""
-        d = self.data_shards
-        lo = self.process_index * d // self.process_count
-        hi = (self.process_index + 1) * d // self.process_count
-        blocks = [
-            pool_concat(
-                {
-                    split: arr[len(arr) // d * s: len(arr) // d * (s + 1)]
-                    for split, arr in self.data.items()
-                }
-            )
-            for s in range(lo, hi)
-        ]
-        return np.concatenate(blocks)
+    # -- data-axis pool sharding (PoolShardedMixin) ------------------------
+    def _pool_split_arrays(self):
+        return self.data
 
     def device_context(self):
         if not self._device_resident:
             return None
-        if self._pool_sharded:
+        if self.wants_data_shards:
             return {"pool": self._local_pool()}
         # Built fresh per call (once per initialize) and NOT retained: the
         # workflow device_puts it, so keeping a concatenated host copy next
@@ -278,29 +150,11 @@ class FullBatchLoader(Loader):
                 def convert(x):
                     return x
 
-            if self._pool_sharded:
-                import jax
-                from jax.sharding import PartitionSpec as P
-
-                from znicz_tpu.parallel.mesh import DATA_AXIS
-
-                mesh = self._mesh
-                spec = P(DATA_AXIS)
-
-                def gather_local(i, p):
-                    # i holds LOCAL addresses into this device's pool
-                    # block (per-shard sampling guarantees locality) —
-                    # the gather never leaves the device
-                    return p[i]
-
-                def pre(idx, ctx):
-                    x = jax.shard_map(
-                        gather_local,
-                        mesh=mesh,
-                        in_specs=(spec, spec),
-                        out_specs=spec,
-                    )(idx, ctx["pool"])
-                    return convert(x)
+            if self.wants_data_shards:
+                # i holds LOCAL addresses into this device's pool block
+                # (per-shard sampling guarantees locality) — the gather
+                # never leaves the device
+                pre = self._shard_map_pre(lambda i, p: convert(p[i]))
 
             else:
 
@@ -333,13 +187,7 @@ class FullBatchLoader(Loader):
             # ship only indices; the jitted step's device_preproc gathers
             # from the HBM-resident pool
             if self.data_shards > 1:
-                # LOCAL address within the owning device's pool block:
-                # split-chunk offset + position inside shard s's chunk
-                idx = np.asarray(indices, np.int64)
-                c = self.class_lengths[split] // self.data_shards
-                data = (
-                    self._local_split_offset[split] + idx % c
-                ).astype(np.int32)
+                data = self._local_addr(indices, split)
             else:
                 data = (
                     np.asarray(indices, np.int32)

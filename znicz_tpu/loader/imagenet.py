@@ -36,6 +36,7 @@ from znicz_tpu.loader.base import (
     pool_concat as base_pool_concat,
     pool_offsets as base_pool_offsets,
 )
+from znicz_tpu.loader.pool_sharded import PoolShardedMixin
 from znicz_tpu.loader.image import IMAGE_EXTENSIONS, _read_image
 
 MEAN_FILE = "mean_rgb.json"
@@ -138,7 +139,7 @@ def pack_image_dir(
     return counts
 
 
-class ImageNetLoader(Loader):
+class ImageNetLoader(PoolShardedMixin, Loader):
     """Packed-u8 image loader with reference augmentation semantics.
 
     ``data_dir`` holds the ``pack_image_dir`` output (or pass a raw image
@@ -159,6 +160,7 @@ class ImageNetLoader(Loader):
         mean_rgb: Optional[Tuple[float, float, float]] = None,
         mmap: bool = True,
         device_resident: bool = False,
+        pool_sharded: bool = False,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -171,6 +173,14 @@ class ImageNetLoader(Loader):
         # tiny per-batch payloads enable the scanned epoch dispatch.
         self._device_resident = bool(device_resident)
         self.epoch_scan_friendly = self._device_resident
+        # pool_sharded: shard the packed pool over the mesh's DATA axis —
+        # REAL ImageNet (~150 GB packed at 256^2) can never fit one chip's
+        # HBM; sharding multiplies capacity by the mesh size
+        # (loader/pool_sharded.py has the full contract)
+        if pool_sharded and not device_resident:
+            raise ValueError("pool_sharded=True requires device_resident")
+        self.wants_data_shards = pool_sharded
+        self._mesh = None
         self._pool_offsets: Dict[str, int] = {}  # set after images load
         if not os.path.isdir(data_dir):
             raise FileNotFoundError(f"no such data_dir: {data_dir}")
@@ -260,15 +270,16 @@ class ImageNetLoader(Loader):
         if self._device_resident:
             # [B, 4] int32 payload: pool row + crop offsets + flip bit —
             # the whole host->device transfer for this minibatch
+            # (pool-sharded: the row is a LOCAL address into the owning
+            # device's block)
+            row = (
+                self._local_addr(indices, split).astype(np.int64)
+                if self.data_shards > 1
+                else np.asarray(indices, np.int64)
+                + self._pool_offsets[split]
+            )
             data = np.stack(
-                [
-                    np.asarray(indices, np.int64)
-                    + self._pool_offsets[split],
-                    oy,
-                    ox,
-                    flip.astype(np.int64),
-                ],
-                axis=1,
+                [row, oy, ox, flip.astype(np.int64)], axis=1
             ).astype(np.int32)
         else:
             from znicz_tpu.loader import native
@@ -285,9 +296,15 @@ class ImageNetLoader(Loader):
             indices=indices,
         )
 
+    def _pool_split_arrays(self):
+        return self.images
+
     def device_context(self):
         if not self._device_resident:
             return None
+        if self.wants_data_shards:
+            # only this process's shards' rows materialize from the mmap
+            return {"pool": self._local_pool()}
         # one up-front transfer of the packed pool; base.pool_concat uses
         # the same ordering _pool_offsets was built from
         return {"pool": base_pool_concat(self.images)}
@@ -315,8 +332,9 @@ class ImageNetLoader(Loader):
 
         cs = self.crop_size
 
-        def pre(payload, ctx):
-            rows = ctx["pool"][payload[:, 0]]  # [B, H, W, 3] u8 gather
+        def crop_batch(payload, pool):
+            rows = pool[payload[:, 0]]  # [B, H, W, 3] u8 gather
+
             def crop_one(img, y, x, f):
                 c = jax.lax.dynamic_slice(
                     img, (y, x, 0), (cs, cs, 3)
@@ -329,5 +347,13 @@ class ImageNetLoader(Loader):
             return crops.astype(jnp.float32) * (1.0 / 255.0) - jnp.asarray(
                 mean, jnp.float32
             )
+
+        if self.wants_data_shards:
+            # payload rows and pool rows are both device-local: the whole
+            # gather+crop+normalize runs per-shard inside a shard_map
+            return self._shard_map_pre(crop_batch)
+
+        def pre(payload, ctx):
+            return crop_batch(payload, ctx["pool"])
 
         return pre
