@@ -398,6 +398,77 @@ Tensor lrn(const Tensor& x, float alpha, float beta, float k, int n_window) {
   return y;
 }
 
+// ---------------------------------------------------------------------------
+// Transformer LM ops (match znicz_tpu/workflow/transformer.py lm_apply /
+// _block_forward and znicz_tpu/ops/attention.py mha semantics)
+// ---------------------------------------------------------------------------
+
+// LayerNorm over the last dim (ops/normalization.py layer_norm, eps 1e-5)
+void layer_norm_rows(Tensor* t, const float* scale, const float* bias) {
+  int d = t->shape.back();
+  int64_t rows = t->size() / d;
+  for (int64_t r = 0; r < rows; ++r) {
+    float* row = t->data.data() + r * d;
+    double mean = 0.0;
+    for (int i = 0; i < d; ++i) mean += row[i];
+    mean /= d;
+    double var = 0.0;
+    for (int i = 0; i < d; ++i) {
+      double c = row[i] - mean;
+      var += c * c;
+    }
+    var /= d;
+    float inv = 1.0f / std::sqrt(static_cast<float>(var) + 1e-5f);
+    for (int i = 0; i < d; ++i)
+      row[i] = (row[i] - static_cast<float>(mean)) * inv * scale[i] + bias[i];
+  }
+}
+
+// x [..., n_in] @ w [n_in, n_out] (+ optional bias) -> [..., n_out]
+Tensor matmul_rows(const Tensor& x, const float* w, const float* b,
+                   int n_in, int n_out) {
+  Tensor y;
+  y.shape = x.shape;
+  y.shape.back() = n_out;
+  int64_t rows = x.size() / n_in;
+  y.data.assign(rows * n_out, 0.0f);
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = x.data.data() + r * n_in;
+    float* yi = y.data.data() + r * n_out;
+    for (int f = 0; f < n_in; ++f) {
+      float xv = xi[f];
+      if (xv == 0.0f) continue;
+      const float* wf = w + static_cast<int64_t>(f) * n_out;
+      for (int o = 0; o < n_out; ++o) yi[o] += xv * wf[o];
+    }
+    if (b)
+      for (int o = 0; o < n_out; ++o) yi[o] += b[o];
+  }
+  return y;
+}
+
+// token ids (rounded from f32 input) [N, T] -> embed[id] + pos[t], [N, T, D]
+Tensor lm_embed(const Tensor& x, const float* embed, int vocab,
+                const float* pos, int max_seq, int d) {
+  int n = x.dim(0), t = x.dim(1);
+  if (t > max_seq)
+    throw std::runtime_error("lm_embed: sequence longer than max_seq");
+  Tensor y;
+  y.shape = {n, t, d};
+  y.data.resize(static_cast<size_t>(n) * t * d);
+  for (int ni = 0; ni < n; ++ni)
+    for (int ti = 0; ti < t; ++ti) {
+      long id = std::lround(x.data[static_cast<int64_t>(ni) * t + ti]);
+      if (id < 0 || id >= vocab)
+        throw std::runtime_error("lm_embed: token id out of vocabulary");
+      const float* e = embed + static_cast<int64_t>(id) * d;
+      const float* p = pos + static_cast<int64_t>(ti) * d;
+      float* out = y.data.data() + (static_cast<int64_t>(ni) * t + ti) * d;
+      for (int i = 0; i < d; ++i) out[i] = e[i] + p[i];
+    }
+  return y;
+}
+
 void softmax_rows(Tensor* t) {
   int c = t->shape.back();
   int64_t rows = t->size() / c;
@@ -419,6 +490,110 @@ struct Layer {
   Json config;
   std::map<std::string, std::pair<std::vector<int>, const float*>> params;
 };
+
+// One pre-LN transformer block: x + causalMHA(ln1(x)), then
+// x + tanh(ln2(x) @ w_up + up_bias) @ w_down + down_bias.
+// Plain tanh — NOT the scaled 1.7159 activation of the conv/FC stack.
+Tensor lm_block(const Tensor& x_in, const Layer& layer) {
+  int n_heads = layer.config.at("n_heads").as_int();
+  int n = x_in.dim(0), t = x_in.dim(1), d = x_in.dim(2);
+  // Validate EVERY param's shape against the activation dims before any
+  // pointer walks: a corrupt/inconsistent artifact must fail cleanly,
+  // never read past the weight blob (the Model::load invariant).
+  auto check = [&](const char* name, std::vector<int> want) {
+    const auto& got = layer.params.at(name).first;
+    if (got != want) {
+      std::string msg = "lm_block: param '" + std::string(name) +
+                        "' shape mismatch (corrupt artifact?)";
+      throw std::runtime_error(msg);
+    }
+  };
+  const auto& wq = layer.params.at("wq");
+  if (wq.first.size() != 2 || wq.first[0] != d)
+    throw std::runtime_error("lm_block: wq must be [d_model, inner]");
+  int inner = wq.first[1];
+  if (inner % n_heads != 0 || n_heads <= 0)
+    throw std::runtime_error("lm_block: inner dim not divisible by heads");
+  const auto& wup = layer.params.at("w_up");
+  if (wup.first.size() != 2 || wup.first[0] != d)
+    throw std::runtime_error("lm_block: w_up must be [d_model, d_ff]");
+  int dff = wup.first[1];
+  check("ln1_scale", {d});
+  check("ln1_bias", {d});
+  check("ln2_scale", {d});
+  check("ln2_bias", {d});
+  check("wk", {d, inner});
+  check("wv", {d, inner});
+  check("wo", {inner, d});
+  check("up_bias", {dff});
+  check("w_down", {dff, d});
+  check("down_bias", {d});
+  int hd = inner / n_heads;
+  float scale = 1.0f / std::sqrt(static_cast<float>(hd));
+
+  Tensor h = x_in;
+  layer_norm_rows(&h, layer.params.at("ln1_scale").second,
+                  layer.params.at("ln1_bias").second);
+  Tensor q = matmul_rows(h, wq.second, nullptr, d, inner);
+  Tensor k = matmul_rows(h, layer.params.at("wk").second, nullptr, d, inner);
+  Tensor v = matmul_rows(h, layer.params.at("wv").second, nullptr, d, inner);
+
+  // causal softmax attention per (batch, head); layouts are head-major
+  // within the inner dim (mha's reshape(b, t, heads, hd))
+  Tensor att;
+  att.shape = {n, t, inner};
+  att.data.assign(static_cast<size_t>(n) * t * inner, 0.0f);
+  std::vector<float> p(t);
+  for (int ni = 0; ni < n; ++ni) {
+    for (int hh = 0; hh < n_heads; ++hh) {
+      for (int tq = 0; tq < t; ++tq) {
+        const float* qrow =
+            q.data.data() + (static_cast<int64_t>(ni) * t + tq) * inner +
+            static_cast<int64_t>(hh) * hd;
+        float mx = -1e30f;
+        for (int tk = 0; tk <= tq; ++tk) {
+          const float* krow =
+              k.data.data() + (static_cast<int64_t>(ni) * t + tk) * inner +
+              static_cast<int64_t>(hh) * hd;
+          float s = 0.0f;
+          for (int i = 0; i < hd; ++i) s += qrow[i] * krow[i];
+          p[tk] = s * scale;
+          mx = std::max(mx, p[tk]);
+        }
+        float sum = 0.0f;
+        for (int tk = 0; tk <= tq; ++tk) {
+          p[tk] = std::exp(p[tk] - mx);
+          sum += p[tk];
+        }
+        float* out =
+            att.data.data() + (static_cast<int64_t>(ni) * t + tq) * inner +
+            static_cast<int64_t>(hh) * hd;
+        for (int tk = 0; tk <= tq; ++tk) {
+          float w = p[tk] / sum;
+          const float* vrow =
+              v.data.data() + (static_cast<int64_t>(ni) * t + tk) * inner +
+              static_cast<int64_t>(hh) * hd;
+          for (int i = 0; i < hd; ++i) out[i] += w * vrow[i];
+        }
+      }
+    }
+  }
+  Tensor o = matmul_rows(att, layer.params.at("wo").second, nullptr,
+                         inner, d);
+  Tensor x = x_in;
+  for (int64_t i = 0; i < x.size(); ++i) x.data[i] += o.data[i];
+
+  Tensor h2 = x;
+  layer_norm_rows(&h2, layer.params.at("ln2_scale").second,
+                  layer.params.at("ln2_bias").second);
+  Tensor u = matmul_rows(h2, wup.second, layer.params.at("up_bias").second,
+                         d, dff);
+  for (auto& uv : u.data) uv = std::tanh(uv);
+  Tensor dn = matmul_rows(u, layer.params.at("w_down").second,
+                          layer.params.at("down_bias").second, dff, d);
+  for (int64_t i = 0; i < x.size(); ++i) x.data[i] += dn.data[i];
+  return x;
+}
 
 struct Model {
   Json header;
@@ -554,6 +729,27 @@ struct Model {
         float k = cfg.has("k") ? cfg.at("k").as_float() : 2.0f;
         int n = cfg.has("n") ? cfg.at("n").as_int() : 5;
         x = lrn(x, alpha, beta, k, n);
+      } else if (t == "lm_embed") {
+        const auto& ep = layer.params.at("embed");  // [vocab, d]
+        const auto& pp = layer.params.at("pos");    // [max_seq, d]
+        if (x.shape.size() != 2)
+          throw std::runtime_error("lm_embed: input must be [N, T] tokens");
+        if (ep.first.size() != 2 || pp.first.size() != 2 ||
+            pp.first[1] != ep.first[1])
+          throw std::runtime_error(
+              "lm_embed: embed/pos tables disagree on d_model "
+              "(corrupt artifact?)");
+        x = lm_embed(x, ep.second, ep.first[0], pp.second, pp.first[0],
+                     ep.first[1]);
+      } else if (t == "lm_block") {
+        if (x.shape.size() != 3)
+          throw std::runtime_error("lm_block: input must be [N, T, D]");
+        x = lm_block(x, layer);
+      } else if (t == "lm_head") {
+        const auto& hp = layer.params.at("head");  // [d, vocab]
+        if (x.shape.size() != 3 || x.dim(2) != hp.first[0])
+          throw std::runtime_error("lm_head: input dim mismatch");
+        x = matmul_rows(x, hp.second, nullptr, hp.first[0], hp.first[1]);
       } else if (t == "dropout") {
         // inference no-op (inverted dropout)
       } else if (t.rfind("activation_", 0) == 0) {

@@ -183,3 +183,56 @@ class TestNativeInference:
         ).stdout
         assert "input_shape: 5" in out
         assert "softmax" in out
+
+
+class TestNativeLMInference:
+    def test_lm_forward_matches_python(self, znicz_infer, tmp_path):
+        # the beyond-parity flagship deploys natively too (SURVEY.md 2.4):
+        # 2-block causal LM, C++ logits == python lm_apply logits
+        from znicz_tpu.export import export_lm_model
+        from znicz_tpu.workflow.transformer import init_lm_params, lm_apply
+
+        prng.seed_all(27)
+        vocab, d, heads, t = 17, 32, 4, 12
+        params = init_lm_params(vocab, d, 2, heads, max_seq=t)
+        tokens = np.random.default_rng(7).integers(
+            0, vocab, (3, t)
+        ).astype(np.int32)
+        y_py = np.asarray(
+            lm_apply(params, jnp.asarray(tokens), n_heads=heads)
+        )
+
+        model_path = str(tmp_path / "lm.znicz")
+        export_lm_model(params, model_path, n_heads=heads)
+        in_path, out_path = str(tmp_path / "in.f32"), str(tmp_path / "o.f32")
+        tokens.astype(np.float32).tofile(in_path)
+        subprocess.run(
+            [znicz_infer, model_path, in_path, out_path, "3"],
+            check=True, capture_output=True,
+        )
+        y_cc = np.fromfile(out_path, np.float32).reshape(3, t, vocab)
+        np.testing.assert_allclose(y_cc, y_py, rtol=1e-4, atol=1e-4)
+
+    def test_lm_describe_and_token_guard(self, znicz_infer, tmp_path):
+        from znicz_tpu.export import export_lm_model
+        from znicz_tpu.workflow.transformer import init_lm_params
+
+        prng.seed_all(28)
+        params = init_lm_params(9, 16, 1, 2, max_seq=6)
+        model_path = str(tmp_path / "lm.znicz")
+        export_lm_model(params, model_path, n_heads=2)
+        out = subprocess.run(
+            [znicz_infer, model_path, "--describe"],
+            check=True, capture_output=True, text=True,
+        ).stdout
+        assert "lm_embed lm_block lm_head" in out
+        # out-of-vocab token ids must fail loudly, not read garbage
+        bad = np.full((1, 6), 42.0, np.float32)
+        in_path, out_path = str(tmp_path / "b.f32"), str(tmp_path / "bo.f32")
+        bad.tofile(in_path)
+        r = subprocess.run(
+            [znicz_infer, model_path, in_path, out_path, "1"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode != 0
+        assert "vocabulary" in r.stderr

@@ -71,18 +71,21 @@ def validate_exportable(model) -> None:
             )
 
 
-def export_model(model, path: str) -> Dict[str, Any]:
-    """Write ``model`` (workflow.model.Model) to ``path``; returns header."""
-    validate_exportable(model)
+def _write_artifact(
+    path: str,
+    input_shape,
+    output_shape,
+    output_kind: str,
+    layer_arrays,
+) -> Dict[str, Any]:
+    """Serialize ``[(type, config, {name: array}), ...]`` to the ZNICZT01
+    binary (shared by the layer-list and LM exporters)."""
     layers = []
     blobs = []
     offset = 0
-    for spec, params in zip(model.layer_specs, model.params):
-        config = {
-            key: _jsonable(spec[key]) for key in _CONFIG_KEYS if key in spec
-        }
+    for ltype, config, params in layer_arrays:
         entry: Dict[str, Any] = {
-            "type": spec["type"],
+            "type": ltype,
             "config": config,
             "params": {},
         }
@@ -98,14 +101,9 @@ def export_model(model, path: str) -> Dict[str, Any]:
         layers.append(entry)
     header = {
         "format": 1,
-        "input_shape": list(model.input_shape),
-        "output_shape": list(model.output_shape),
-        # The ENGINE's output semantics, not the python model's: znicz_infer
-        # applies softmax for a softmax head, so a softmax-headed model
-        # (returns_logits in python) emits probabilities from the artifact.
-        "output_kind": (
-            "probabilities" if model.returns_logits else "raw"
-        ),
+        "input_shape": list(input_shape),
+        "output_shape": list(output_shape),
+        "output_kind": output_kind,
         "layers": layers,
     }
     payload = json.dumps(header).encode()
@@ -116,6 +114,77 @@ def export_model(model, path: str) -> Dict[str, Any]:
         for blob in blobs:
             f.write(blob.tobytes())
     return header
+
+
+def export_model(model, path: str) -> Dict[str, Any]:
+    """Write ``model`` (workflow.model.Model) to ``path``; returns header."""
+    validate_exportable(model)
+    layer_arrays = [
+        (
+            spec["type"],
+            {key: _jsonable(spec[key]) for key in _CONFIG_KEYS if key in spec},
+            params,
+        )
+        for spec, params in zip(model.layer_specs, model.params)
+    ]
+    return _write_artifact(
+        path,
+        model.input_shape,
+        model.output_shape,
+        # The ENGINE's output semantics, not the python model's: znicz_infer
+        # applies softmax for a softmax head, so a softmax-headed model
+        # (returns_logits in python) emits probabilities from the artifact.
+        "probabilities" if model.returns_logits else "raw",
+        layer_arrays,
+    )
+
+
+_LM_BLOCK_KEYS = (
+    "ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
+    "ln2_scale", "ln2_bias", "w_up", "up_bias", "w_down", "down_bias",
+)
+
+
+def export_lm_model(params, path: str, *, n_heads: int) -> Dict[str, Any]:
+    """Export a transformer LM for the native engine (SURVEY.md 2.4: the
+    beyond-parity flagship deploys the way every parity model does).
+
+    ``params``: the flat ``init_lm_params`` layout
+    ``[embed, block_0..L-1, head]`` (``TransformerLMWorkflow.state.params``
+    for non-pipelined runs).  Artifact I/O: input = [T] token ids stored
+    as float32 in the raw file; output = [T, vocab] logits
+    (``output_kind="raw"`` — matches python ``lm_apply``).
+    """
+    if not isinstance(params, (list, tuple)) or len(params) < 3:
+        raise ValueError(
+            "export_lm_model wants the flat [embed, blocks..., head] param "
+            "list; pipelined (stacked-stage) params must be exported from "
+            "a non-pipelined workflow"
+        )
+    embed, head, blocks = params[0], params[-1], params[1:-1]
+    pos = np.asarray(embed["pos"])
+    max_seq, d_model = pos.shape
+    vocab = int(np.asarray(embed["embed"]).shape[0])
+    layer_arrays = [
+        ("lm_embed", {}, {"embed": embed["embed"], "pos": embed["pos"]})
+    ]
+    for block in blocks:
+        inner = int(np.asarray(block["wq"]).shape[1])
+        if inner % n_heads:
+            raise ValueError(
+                f"block inner dim {inner} not divisible by n_heads {n_heads}"
+            )
+        layer_arrays.append(
+            (
+                "lm_block",
+                {"n_heads": int(n_heads)},
+                {k: block[k] for k in _LM_BLOCK_KEYS},
+            )
+        )
+    layer_arrays.append(("lm_head", {}, {"head": head["head"]}))
+    return _write_artifact(
+        path, [max_seq], [max_seq, vocab], "raw", layer_arrays
+    )
 
 
 def _jsonable(v):
