@@ -214,6 +214,27 @@ class TestLauncherCLI:
         assert launcher.workflow.epoch_sync == "deferred"
         assert launcher.result.epoch == 2  # exact stop despite the lag
 
+    def test_epoch_sync_with_interval_snapshots(self, tmp_path):
+        # the deferred-compatible snapshot kind is reachable from the CLI
+        wf_py = tmp_path / "wf.py"
+        wf_py.write_text(
+            "from znicz_tpu.models.wine import run  # noqa: F401\n"
+        )
+        launcher = run_args(
+            [str(wf_py), "--random-seed", "1", "--stop-after", "4",
+             "--epoch-sync", "deferred",
+             "--snapshot-dir", str(tmp_path / "snaps"),
+             "--snapshot-interval", "2"]
+        )
+        snap = launcher.workflow.snapshotter
+        assert snap.interval == 2 and not snap.save_best
+        import os as _os
+
+        names = sorted(_os.listdir(tmp_path / "snaps"))
+        assert any("epoch1" in n for n in names), names
+        assert any("epoch3" in n for n in names), names
+        assert not any("best" in n for n in names), names
+
     def test_dry_run(self, tmp_path):
         wf_py = tmp_path / "wf.py"
         wf_py.write_text(

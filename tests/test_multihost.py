@@ -222,6 +222,60 @@ print(f"OK process={jax.process_index()}")
 """
 
 
+POOL_SHARDED_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, sys.argv[3])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from znicz_tpu.parallel import multihost
+
+info = multihost.initialize(
+    coordinator_address=sys.argv[1], num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+assert info["global_devices"] == 4, info
+
+import numpy as np
+from znicz_tpu.core import prng
+from znicz_tpu.loader import FullBatchLoader
+from znicz_tpu.parallel import DataParallel, make_mesh
+from znicz_tpu.workflow import StandardWorkflow
+
+gen = np.random.default_rng(3)
+imgs = gen.integers(0, 256, (128, 8, 8, 1), dtype=np.uint8)
+labels = (imgs.mean(axis=(1, 2, 3)) > 127).astype(np.int32)
+prng.seed_all(67)
+loader = FullBatchLoader(
+    {"train": imgs}, {"train": labels}, minibatch_size=32,
+    normalization="range",
+    normalization_kwargs={"scale": 255.0, "shift": -0.5},
+    device_resident=True, pool_sharded=True,
+)
+wf = StandardWorkflow(
+    loader,
+    [{"type": "all2all_tanh", "->": {"output_sample_shape": 8}},
+     {"type": "softmax", "->": {"output_sample_shape": 2}}],
+    decision_config={"max_epochs": 3},
+    default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+)
+wf.parallel = DataParallel(make_mesh(4, 1))
+wf.initialize(seed=67)
+# each PROCESS shipped only its 2 shards' rows; the global pool spans all 4
+pool = wf._ctx["pool"]
+assert pool.shape[0] == 128
+assert not pool.is_fully_addressable
+assert pool.addressable_shards[0].data.shape[0] == 32
+dec = wf.run()
+hist = [e["train"]["loss"] for e in dec.history]
+print("HIST" + str(jax.process_index()) + "=" + json.dumps(hist))
+print(f"OK process={jax.process_index()}")
+"""
+
+
 KILL_WORKER = r"""
 import json, os, signal, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -487,6 +541,78 @@ def test_two_process_tensor_parallel_conv_training(tmp_path):
         ),
     )
     wf.initialize(seed=55)
+    base = [e["train"]["loss"] for e in wf.run().history]
+    np.testing.assert_allclose(base, hists[0], rtol=1e-4)
+
+
+def test_two_process_pool_sharded_training(tmp_path):
+    """Multi-host x data-axis-sharded HBM pool: each process device_puts
+    ONLY its shards' rows (the capacity contract that lets the pooled
+    dataset exceed any one host/chip), assembled globally via
+    make_array_from_process_local_data; losses must match the
+    single-process 4-device pool-sharded run."""
+    import json
+
+    import numpy as np
+
+    addr = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", POOL_SHARDED_WORKER, addr, str(pid), REPO],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("pool-sharded worker timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err}"
+    hists = {}
+    for _, out, _ in outs:
+        for line in out.splitlines():
+            if line.startswith("HIST"):
+                pid, _, payload = line[4:].partition("=")
+                hists[int(pid)] = json.loads(payload)
+    assert set(hists) == {0, 1}
+    assert hists[0] == hists[1]
+
+    # single-process baseline: same config on a 4-device mesh
+    import jax
+
+    from znicz_tpu.core import prng
+    from znicz_tpu.loader import FullBatchLoader
+    from znicz_tpu.parallel import DataParallel, make_mesh
+    from znicz_tpu.workflow import StandardWorkflow
+
+    gen = np.random.default_rng(3)
+    imgs = gen.integers(0, 256, (128, 8, 8, 1), dtype=np.uint8)
+    labels = (imgs.mean(axis=(1, 2, 3)) > 127).astype(np.int32)
+    prng.seed_all(67)
+    loader = FullBatchLoader(
+        {"train": imgs}, {"train": labels}, minibatch_size=32,
+        normalization="range",
+        normalization_kwargs={"scale": 255.0, "shift": -0.5},
+        device_resident=True, pool_sharded=True,
+    )
+    wf = StandardWorkflow(
+        loader,
+        [{"type": "all2all_tanh", "->": {"output_sample_shape": 8}},
+         {"type": "softmax", "->": {"output_sample_shape": 2}}],
+        decision_config={"max_epochs": 3},
+        default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+        parallel=DataParallel(make_mesh(4, 1, devices=jax.devices()[:4])),
+    )
+    wf.initialize(seed=67)
     base = [e["train"]["loss"] for e in wf.run().history]
     np.testing.assert_allclose(base, hists[0], rtol=1e-4)
 

@@ -53,6 +53,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--random-seed", type=int, default=None)
     p.add_argument("--snapshot", default=None,
                    help="resume training from this snapshot file")
+    p.add_argument("--snapshot-interval", type=int, default=None,
+                   metavar="K",
+                   help="also snapshot every K epochs (with --epoch-sync "
+                        "deferred this implies save_best=False: interval-"
+                        "only snapshots are the deferred-compatible kind)")
     p.add_argument("--snapshot-dir", default=None,
                    help="write snapshots under this directory")
     p.add_argument("--data-parallel", action="store_true",
@@ -99,8 +104,9 @@ def make_parser() -> argparse.ArgumentParser:
                    choices=["sync", "deferred"],
                    help="deferred: overlap the per-epoch metric fetch with "
                         "the next epoch's dispatch (verdicts lag one epoch; "
-                        "stop decisions stay exact; incompatible with a "
-                        "snapshotter)")
+                        "stop decisions stay exact; snapshots must be "
+                        "interval-only: Snapshotter(interval=k, "
+                        "save_best=False))")
     p.add_argument("--dry-run", action="store_true",
                    help="build and initialize the workflow, run nothing")
     p.add_argument("--verbose", action="store_true")
@@ -120,6 +126,12 @@ class Launcher(Logger):
         """Construct the workflow, applying CLI overrides."""
         if self.args.snapshot_dir and "snapshot_dir" not in wf_kwargs:
             wf_kwargs["snapshot_dir"] = self.args.snapshot_dir
+        if getattr(self.args, "snapshot_interval", None):
+            sc = dict(wf_kwargs.get("snapshot_config") or {})
+            sc.setdefault("interval", self.args.snapshot_interval)
+            if self.args.epoch_sync == "deferred":
+                sc.setdefault("save_best", False)
+            wf_kwargs["snapshot_config"] = sc
         if (
             getattr(self.args, "epoch_sync", None)
             and "epoch_sync" not in wf_kwargs

@@ -99,12 +99,18 @@ class Snapshotter:
         compress: bool = True,
         interval: int = 0,
         keep: int = 3,
+        save_best: bool = True,
     ):
         self.directory = directory
         self.prefix = prefix
         self.compress = compress
         self.interval = interval
         self.keep = keep
+        # save_best=False: interval-only snapshots.  Required under the
+        # workflow's deferred epoch sync: improvement is only known one
+        # epoch late (when the state has advanced), while interval epochs
+        # are known in advance and flushed synchronously.
+        self.save_best = save_best
         # multi-host: the Workflow sets writer=False on non-coordinator
         # processes — they still participate in save()'s (possibly
         # collective) device->host readback, but never touch the filesystem
@@ -164,10 +170,11 @@ class Snapshotter:
         epoch: int,
         improved: bool,
     ) -> Optional[str]:
-        """Snapshot policy: on validation improvement -> overwrite 'best';
-        every ``interval`` epochs -> tagged periodic snapshot."""
+        """Snapshot policy: on validation improvement -> overwrite 'best'
+        (unless ``save_best=False``); every ``interval`` epochs -> tagged
+        periodic snapshot."""
         path = None
-        if improved:
+        if improved and self.save_best:
             path = self.save(train_state, host_state, tag="best")
         if self.interval and (epoch + 1) % self.interval == 0:
             path = self.save(train_state, host_state, tag=f"epoch{epoch}")

@@ -431,16 +431,6 @@ class TransformerLMWorkflow(Workflow):
                         "pipeline+tensor parallel needs a mesh with a "
                         "'model' axis > 1"
                     )
-                if attention == "flash":
-                    # flash under PPxTP would run the model-axis param
-                    # sharding with check_vma=False (pallas out_shapes
-                    # carry no vma info) — a gradient path with the
-                    # replication checks off that no test validates yet
-                    raise ValueError(
-                        "attention='flash' is not yet validated under "
-                        "pipeline+tensor parallel; use attention='dot' "
-                        "(or 'auto', which selects dot here)"
-                    )
                 if n_heads % n_model:
                     raise ValueError(
                         f"n_heads={n_heads} not divisible by model axis "
@@ -571,10 +561,6 @@ class TransformerLMWorkflow(Workflow):
                 else "dense"
             )
             return partial(ring_attention, mesh=self.mesh, inner=inner)
-        if self.pipeline_parallel and self.tensor_parallel:
-            # flash under PPxTP is rejected in __init__; auto selects the
-            # dense kernel here until that gradient path is validated
-            return None
         # blockwise flash kernel (ops/pallas/attention.py): O(T·D) memory
         # and VMEM-resident online softmax — the long-context default on
         # TPU once the quadratic score matrix stops being a rounding error

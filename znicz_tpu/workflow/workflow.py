@@ -110,13 +110,18 @@ class Workflow(Logger):
                 f"epoch_sync={epoch_sync!r}: want 'sync' or 'deferred'"
             )
         if epoch_sync == "deferred" and snapshotter is not None:
-            # a deferred epoch's snapshot would capture the NEXT epoch's
-            # params — the lag is fine for metrics, wrong for state
-            raise ValueError(
-                "epoch_sync='deferred' is incompatible with a snapshotter "
-                "(the state to snapshot has already advanced when the "
-                "lagged verdict arrives); use epoch_sync='sync'"
-            )
+            # interval snapshots compose: interval epochs are known in
+            # advance, so run_epoch flushes them synchronously BEFORE the
+            # next dispatch while self.state is still that epoch's.
+            # Improvement-driven 'best' saves cannot — improvement is only
+            # known after the lagged fetch, when the state has advanced.
+            if not snapshotter.interval or snapshotter.save_best:
+                raise ValueError(
+                    "epoch_sync='deferred' needs interval-only snapshots: "
+                    "Snapshotter(interval=k, save_best=False) (improvement"
+                    "-driven saves would capture the NEXT epoch's params); "
+                    "or use epoch_sync='sync'"
+                )
         self.epoch_sync = epoch_sync
         self._pending_accs = None
         self.services = []  # per-epoch observers: plotters, status, image saver
@@ -396,6 +401,15 @@ class Workflow(Logger):
                 multihost.process_index(), multihost.process_count()
             )
         if self.snapshotter is not None:
+            # mirror the constructor check: the snapshotter may have been
+            # assigned after construction (tests, launcher overrides)
+            if self.epoch_sync == "deferred" and (
+                not self.snapshotter.interval or self.snapshotter.save_best
+            ):
+                raise ValueError(
+                    "epoch_sync='deferred' needs interval-only snapshots: "
+                    "Snapshotter(interval=k, save_best=False)"
+                )
             self.snapshotter.writer = self._coordinator
         # host-side mirror of state.step: lr policies read it every minibatch
         # and must not force a device sync in the hot loop
@@ -525,10 +539,28 @@ class Workflow(Logger):
             self.initialize()
         deferred = self.epoch_sync == "deferred"
         flushed = None
+        # pending must resolve synchronously (BEFORE the next dispatch)
+        # when its verdict could stop training, or when it is an interval-
+        # snapshot epoch (self.state is still that epoch's right now)
+        if deferred and self.snapshotter is not None and (
+            not self.snapshotter.interval or self.snapshotter.save_best
+        ):
+            # also enforced at construction/initialize; this catches a
+            # snapshotter assigned after initialize(), which would
+            # otherwise silently write one-epoch-ahead train states
+            raise ValueError(
+                "epoch_sync='deferred' needs interval-only snapshots: "
+                "Snapshotter(interval=k, save_best=False)"
+            )
+        pending_snapshots = (
+            self.snapshotter is not None
+            and self.snapshotter.interval
+            and (self.decision.epoch + 1) % self.snapshotter.interval == 0
+        )
         if (
             deferred
             and self._pending_accs is not None
-            and self.decision.can_stop_next_epoch()
+            and (self.decision.can_stop_next_epoch() or pending_snapshots)
         ):
             accs, self._pending_accs = self._pending_accs, None
             flushed = self._finish_epoch(accs)
