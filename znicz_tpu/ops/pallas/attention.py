@@ -35,10 +35,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# 256 blocks measured fastest on v5e (fwd+bwd causal: 2.7x the jnp twin at
-# T=2048, 2.3x at T=8192 — tests/test_pallas.py TPU timing assertion)
-BLOCK_Q = 256
-BLOCK_K = 256
+# 512x512 measured best on v5e at T=2048, hd=64 (fwd 23.4 -> 20.1 ms,
+# fwd+bwd 31.1 -> 23.5 ms vs 256x256; ~2 MB VMEM per program, well under
+# budget); 128/256 variants are strictly slower, bf16 inputs too (the
+# kernel computes f32 internally — v5e has no bf16 VPU transcendentals —
+# so halved loads lose to the conversion traffic)
+BLOCK_Q = 512
+BLOCK_K = 512
 NEG_INF = -1e30
 
 
