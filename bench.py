@@ -425,16 +425,18 @@ def main() -> None:
         p_mat = L * (4 * d * d + 2 * d * (4 * d)) + d * v
         return 3.0 * (2.0 * p_mat + 4.0 * L * LM_T * d)
 
-    def lm_rate(cfg, b, attention: str, remat: bool) -> float:
+    def lm_rate(cfg, b, attention: str, remat: bool, tokens=None) -> float:
+        tokens = lm_tokens if tokens is None else tokens
+        t_len = tokens.shape[1]
         prng.seed_all(99)
         ld = FullBatchLoader(
-            {"train": lm_tokens[: 2 * b].copy()}, minibatch_size=b
+            {"train": tokens[: 2 * b].copy()}, minibatch_size=b
         )
         lwf = TransformerLMWorkflow(
             ld, max_epochs=1, attention=attention, remat=remat, **cfg
         )
         lwf.initialize(seed=99)
-        lx = jnp.asarray(lm_tokens[:b])
+        lx = jnp.asarray(tokens[:b])
         ly = jnp.zeros((b,), jnp.int32)
         lmask = jnp.ones((b,), jnp.float32)
         lstep = lwf.train_step_fn
@@ -458,13 +460,13 @@ def main() -> None:
             return time.time() - t0
 
         dt = min(timed() for _ in range(3)) / n_inner
-        return b * LM_T / dt
+        return b * t_len / dt
 
-    def lm_rate_safe(cfg, b, attention, remat) -> float:
+    def lm_rate_safe(cfg, b, attention, remat, tokens=None) -> float:
         # HBM headroom through the relay varies run to run — a failed LM
         # variant must degrade to 0.0, never kill the whole bench
         try:
-            return lm_rate(cfg, b, attention, remat)
+            return lm_rate(cfg, b, attention, remat, tokens=tokens)
         except Exception as e:
             print(
                 f"lm config d={cfg['d_model']} B={b} {attention} "
@@ -482,11 +484,23 @@ def main() -> None:
         LM_MID_B = 8
         lm_mid = lm_rate_safe(LM_MID, LM_MID_B, "flash", remat=False)
     lm_mid_mfu = lm_mid * lm_train_flops_per_token(LM_MID) / peak
+
+    # long context: flash (O(T*D) memory) + remat train the mid model at
+    # 8x the headline sequence length on ONE chip — dense attention OOMs
+    # at T=2048 already.  T=16384, B=2 (32k tokens/step, same as mid).
+    LM_LONG_T, LM_LONG_B = 16384, 2
+    lm_long_tokens = np.random.default_rng(8).integers(
+        0, 8192, (2 * LM_LONG_B, LM_LONG_T)
+    ).astype(np.int32)
+    lm_long = lm_rate_safe(
+        LM_MID, LM_LONG_B, "flash", remat=True, tokens=lm_long_tokens
+    )
     print(
         f"LM GPT-small T={LM_T}: flash {lm_flash:.0f} tok/s "
         f"(MFU {lm_mfu:.3f}), dense {lm_dense:.0f}, "
         f"flash+remat {lm_flash_remat:.0f}; "
-        f"mid 512dx12L: {lm_mid:.0f} tok/s (MFU {lm_mid_mfu:.3f})",
+        f"mid 512dx12L: {lm_mid:.0f} tok/s (MFU {lm_mid_mfu:.3f}); "
+        f"long T={LM_LONG_T}: {lm_long:.0f} tok/s",
         file=sys.stderr,
     )
     fwd_flops = _model_flops_per_image(
@@ -566,6 +580,11 @@ def main() -> None:
                 ),
                 "lm_mid_tokens_per_sec": round(lm_mid, 1),
                 "lm_mid_mfu": round(lm_mid_mfu, 4),
+                "lm_long_context": (
+                    f"mid config at T={LM_LONG_T}, B={LM_LONG_B}, "
+                    "flash+remat (dense OOMs at T=2048 already)"
+                ),
+                "lm_long_tokens_per_sec": round(lm_long, 1),
                 "device": str(jax.devices()[0].device_kind),
             }
         )

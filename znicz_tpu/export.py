@@ -168,7 +168,15 @@ def export_lm_model(params, path: str, *, n_heads: int) -> Dict[str, Any]:
     layer_arrays = [
         ("lm_embed", {}, {"embed": embed["embed"], "pos": embed["pos"]})
     ]
+    from znicz_tpu.workflow.transformer import MOE_KEY_MAP
+
     for block in blocks:
+        if any(k in block for k in MOE_KEY_MAP):
+            raise ValueError(
+                "mixture-of-experts blocks are not implemented by the "
+                "native engine (native/znicz_infer.cc); export a dense-FFN "
+                "LM (moe_experts=0)"
+            )
         inner = int(np.asarray(block["wq"]).shape[1])
         if inner % n_heads:
             raise ValueError(

@@ -31,6 +31,11 @@ DEFAULTS = {
     # route to pipeline parallelism; n_layers must divide by it)
     "pipeline_stages": 0,
     "pipeline_microbatches": 0,
+    # >1: each block's FFN becomes a gated mixture of experts (EP; the
+    # expert dim shards over the mesh's model axis under TP)
+    "moe_experts": 0,
+    "moe_top_k": 1,
+    "moe_dispatch": "dense",
 }
 root.transformer_lm.update(DEFAULTS)
 
@@ -74,6 +79,9 @@ def build_workflow(**overrides) -> TransformerLMWorkflow:
         "n_heads": cfg.get("n_heads", 4),
         "max_epochs": cfg.get("max_epochs", 15),
         "remat": bool(cfg.get("remat", False)),
+        "moe_experts": int(cfg.get("moe_experts", 0) or 0),
+        "moe_top_k": int(cfg.get("moe_top_k", 1) or 1),
+        "moe_dispatch": cfg.get("moe_dispatch", "dense"),
         "name": "TransformerLMWorkflow",
     }
     pp_stages = int(cfg.get("pipeline_stages", 0) or 0)

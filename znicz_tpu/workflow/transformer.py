@@ -43,9 +43,15 @@ def init_lm_params(
     max_seq: int,
     *,
     d_ff: Optional[int] = None,
+    moe_experts: int = 0,
     rand_name: str = "default",
 ):
-    """[embed, block_0, ..., block_{L-1}, head] — flat dicts per layer."""
+    """[embed, block_0, ..., block_{L-1}, head] — flat dicts per layer.
+
+    ``moe_experts > 1``: each block's FFN becomes a gated
+    mixture-of-experts (:mod:`znicz_tpu.ops.moe`) with ``moe_experts``
+    experts of hidden size ``d_ff`` — the EP axis composes into the LM.
+    """
     gen = prng.get(rand_name)
     d_ff = d_ff or 4 * d_model
     std = 1.0 / np.sqrt(d_model)
@@ -61,15 +67,30 @@ def init_lm_params(
             "ln1_bias": jnp.zeros((d_model,)),
             "ln2_scale": jnp.ones((d_model,)),
             "ln2_bias": jnp.zeros((d_model,)),
+        }
+        if moe_experts > 1:
+            from znicz_tpu.ops import moe as moe_op
+
+            m = moe_op.init_params(
+                d_model, d_ff, moe_experts, rand_name=rand_name
+            )
             # names end in "bias" so HyperParams' *_bias multiplier rules
             # classify them like every other workflow's biases
-            "w_up": jnp.asarray(fill(gen, (d_model, d_ff), "gaussian", std)),
-            "up_bias": jnp.zeros((d_ff,)),
-            "w_down": jnp.asarray(
-                fill(gen, (d_ff, d_model), "gaussian", 1.0 / np.sqrt(d_ff))
-            ),
-            "down_bias": jnp.zeros((d_model,)),
-        }
+            block.update({k: m[v] for k, v in MOE_KEY_MAP.items()})
+        else:
+            block.update(
+                w_up=jnp.asarray(
+                    fill(gen, (d_model, d_ff), "gaussian", std)
+                ),
+                up_bias=jnp.zeros((d_ff,)),
+                w_down=jnp.asarray(
+                    fill(
+                        gen, (d_ff, d_model), "gaussian",
+                        1.0 / np.sqrt(d_ff),
+                    )
+                ),
+                down_bias=jnp.zeros((d_model,)),
+            )
         block.update(
             attention.init_mha_params(
                 d_model, n_heads, rand_name=rand_name
@@ -87,7 +108,43 @@ def _embed_tokens(embed, tokens):
     return embed["embed"][tokens] + embed["pos"][:t][None, :, :]
 
 
-def _block_forward(block, x, *, n_heads, attention_fn=None):
+# MoE param names in the block's FLAT dict -> ops/moe's schema.  THE one
+# mapping: init_lm_params, _block_ffn, lm_tp_rules and export's guard all
+# derive from it, so adding/renaming an MoE leaf cannot silently miss a
+# site (a leaf absent from the TP list would fall through to replicated
+# placement while its siblings shard on the expert dim).
+MOE_KEY_MAP = {
+    "moe_router": "router",
+    "moe_w_up": "w1",      # [E, D, F]
+    "moe_up_bias": "b1",   # [E, F]
+    "moe_w_down": "w2",    # [E, F, D]
+    "moe_down_bias": "b2",  # [E, D]
+}
+# every non-router leaf carries a leading expert dim (EP shards it)
+_MOE_EXPERT_SHARDED = tuple(k for k in MOE_KEY_MAP if k != "moe_router")
+
+
+def _block_ffn(block, h, *, moe_top_k=1, moe_dispatch="dense"):
+    """The block's position-wise FFN: dense two-layer tanh, or — when the
+    block carries MoE params — a gated mixture of experts over the
+    flattened token dim."""
+    if "moe_router" in block:
+        from znicz_tpu.ops import moe as moe_op
+
+        b, t, d = h.shape
+        y = moe_op.apply(
+            {v: block[k] for k, v in MOE_KEY_MAP.items()},
+            h.reshape(b * t, d),
+            top_k=moe_top_k,
+            dispatch=moe_dispatch,
+        )
+        return y.reshape(b, t, d)
+    h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
+    return h @ block["w_down"] + block["down_bias"]
+
+
+def _block_forward(block, x, *, n_heads, attention_fn=None,
+                   moe_top_k=1, moe_dispatch="dense"):
     """One pre-LN transformer block (the ONLY definition — lm_apply and the
     pipelined stage_fn both call it, so they cannot drift apart)."""
     attention_fn = attention_fn or attention.dot_product_attention
@@ -96,8 +153,9 @@ def _block_forward(block, x, *, n_heads, attention_fn=None):
         block, h, n_heads=n_heads, causal=True, attention_fn=attention_fn
     )
     h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
-    h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
-    return x + h @ block["w_down"] + block["down_bias"]
+    return x + _block_ffn(
+        block, h, moe_top_k=moe_top_k, moe_dispatch=moe_dispatch
+    )
 
 
 def _block_forward_tp(block, x, *, n_heads_local, tp_axis, attention_fn=None):
@@ -125,7 +183,8 @@ def _block_forward_tp(block, x, *, n_heads_local, tp_axis, attention_fn=None):
     return x + jax.lax.psum(h @ block["w_down"], tp_axis) + block["down_bias"]
 
 
-def lm_apply(params, tokens, *, n_heads, attention_fn=None, remat=False):
+def lm_apply(params, tokens, *, n_heads, attention_fn=None, remat=False,
+             moe_top_k=1, moe_dispatch="dense"):
     """tokens [B, T] int32 -> logits [B, T, vocab].
 
     ``remat``: wrap each block in ``jax.checkpoint`` — activations are
@@ -134,7 +193,10 @@ def lm_apply(params, tokens, *, n_heads, attention_fn=None, remat=False):
     FLOPs.  The long-context lever jax gives for free; numerics are
     unchanged (same ops, re-run)."""
     attention_fn = attention_fn or attention.dot_product_attention
-    blk = partial(_block_forward, n_heads=n_heads, attention_fn=attention_fn)
+    blk = partial(
+        _block_forward, n_heads=n_heads, attention_fn=attention_fn,
+        moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+    )
     if remat:
         blk = jax.checkpoint(blk)
     x = _embed_tokens(params[0], tokens)
@@ -169,6 +231,7 @@ def stack_lm_blocks(params, n_stages: int):
 def lm_apply_pipelined(
     params_pp, tokens, *, n_heads, mesh, n_microbatches,
     data_axis=None, tp_axis=None, attention_fn=None, remat=False,
+    moe_top_k=1, moe_dispatch="dense",
 ):
     """tokens [B, T] -> logits, with the block tower pipelined over the
     mesh's ``pipe`` axis (embed/head run outside the shard_map);
@@ -197,7 +260,8 @@ def lm_apply_pipelined(
         param_spec_fn = _pp_stage_tp_specs(tp_axis)
     else:
         blk = partial(
-            _block_forward, n_heads=n_heads, attention_fn=attention_fn
+            _block_forward, n_heads=n_heads, attention_fn=attention_fn,
+            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
         )
     if remat:  # recompute per-block activations in the backward pipeline
         blk = jax.checkpoint(blk)
@@ -296,6 +360,10 @@ def lm_tp_rules(path: str, leaf):
     """
     from jax.sharding import PartitionSpec as P
 
+    if any(f"'{k}'" in path for k in _MOE_EXPERT_SHARDED):
+        # expert parallelism: the leading expert dim shards over model
+        # (ops/moe.expert_sharding's placement; GSPMD psums the combine)
+        return P(MODEL_AXIS, *([None] * (leaf.ndim - 1)))
     if any(k in path for k in ("'wq'", "'wk'", "'wv'", "'w_up'", "'head'")):
         return P(None, MODEL_AXIS)
     if any(k in path for k in ("'wo'", "'w_down'")):
@@ -346,6 +414,9 @@ class TransformerLMWorkflow(Workflow):
         hyper: Optional[optimizer.HyperParams] = None,
         attention: str = "auto",  # "dot" | "flash" | "auto"
         remat: bool = False,  # jax.checkpoint each block (long context)
+        moe_experts: int = 0,  # >1: MoE FFN per block (ops/moe.py)
+        moe_top_k: int = 1,
+        moe_dispatch: str = "dense",  # "dense" | "capacity"
         sequence_parallel: bool = False,
         tensor_parallel: bool = False,
         pipeline_parallel: bool = False,
@@ -387,6 +458,15 @@ class TransformerLMWorkflow(Workflow):
         self.rand_name = rand_name
         self.attention = attention
         self.remat = remat
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_dispatch = moe_dispatch
+        if moe_experts > 1 and pipeline_parallel and tensor_parallel:
+            raise ValueError(
+                "moe_experts is not supported under pipeline+tensor "
+                "parallel (the manual-TP stage forward has no expert "
+                "collectives); use PP alone, TP alone, or DP x EP"
+            )
         self.sequence_parallel = sequence_parallel
         self.tensor_parallel = tensor_parallel
         self.pipeline_parallel = pipeline_parallel
@@ -593,11 +673,15 @@ class TransformerLMWorkflow(Workflow):
                 tp_axis=MODEL_AXIS if self.tensor_parallel else None,
                 attention_fn=attention_fn,
                 remat=self.remat,
+                moe_top_k=self.moe_top_k,
+                moe_dispatch=self.moe_dispatch,
             )
         else:
             apply_fn = partial(
                 lm_apply, n_heads=n_heads, attention_fn=attention_fn,
                 remat=self.remat,
+                moe_top_k=self.moe_top_k,
+                moe_dispatch=self.moe_dispatch,
             )
 
         def loss_metrics(params, tokens, mask):
@@ -674,6 +758,7 @@ class TransformerLMWorkflow(Workflow):
             self.n_layers,
             self.n_heads,
             self.max_seq,
+            moe_experts=self.moe_experts,
             rand_name=self.rand_name,
         )
         if self.pipeline_parallel:
