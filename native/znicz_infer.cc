@@ -11,6 +11,7 @@
 //     OUTPUT.f32: raw float32 written back, batch x output_shape
 //   znicz_infer MODEL.znicz --describe
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -491,8 +492,83 @@ struct Layer {
   std::map<std::string, std::pair<std::vector<int>, const float*>> params;
 };
 
+// Gated mixture-of-experts FFN (ops/moe.py dense-dispatch semantics):
+// router softmax over top-k logits (renormalized), every selected expert
+// runs x @ w1 + b1 -> tanh -> @ w2 + b2, gate-weighted combine.
+// h [R, d] flattened tokens; params carry a leading expert dim.
+Tensor moe_ffn(const Tensor& h, const Layer& layer, int top_k) {
+  const auto& router = layer.params.at("moe_router");  // [d, E]
+  const auto& w1 = layer.params.at("moe_w_up");        // [E, d, dff]
+  const auto& b1 = layer.params.at("moe_up_bias");     // [E, dff]
+  const auto& w2 = layer.params.at("moe_w_down");      // [E, dff, d]
+  const auto& b2 = layer.params.at("moe_down_bias");   // [E, d]
+  int d = h.shape.back();
+  if (router.first.size() != 2 || router.first[0] != d)
+    throw std::runtime_error("moe: router must be [d_model, E]");
+  int e = router.first[1];
+  int dff = w1.first.size() == 3 ? w1.first[2] : -1;
+  if (w1.first != std::vector<int>{e, d, dff} ||
+      b1.first != std::vector<int>{e, dff} ||
+      w2.first != std::vector<int>{e, dff, d} ||
+      b2.first != std::vector<int>{e, d} || dff <= 0)
+    throw std::runtime_error("moe: expert param shape mismatch");
+  if (top_k < 1) top_k = 1;
+  if (top_k > e) top_k = e;
+  int64_t rows = h.size() / d;
+  Tensor y;
+  y.shape = h.shape;
+  y.data.assign(h.data.size(), 0.0f);
+  std::vector<float> logits(e), hid(dff), gate(top_k);
+  std::vector<int> idx(e);
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xr = h.data.data() + r * d;
+    for (int j = 0; j < e; ++j) {
+      float s = 0.0f;
+      for (int i = 0; i < d; ++i) s += xr[i] * router.second[
+          static_cast<int64_t>(i) * e + j];
+      logits[j] = s;
+      idx[j] = j;
+    }
+    // top-k expert ids by logit (ties: lower id first — matches
+    // jax.lax.top_k's stable ordering)
+    std::partial_sort(idx.begin(), idx.begin() + top_k, idx.end(),
+                      [&](int a, int b) {
+                        return logits[a] != logits[b] ? logits[a] > logits[b]
+                                                      : a < b;
+                      });
+    float mx = logits[idx[0]], sum = 0.0f;
+    for (int k = 0; k < top_k; ++k) {
+      gate[k] = std::exp(logits[idx[k]] - mx);
+      sum += gate[k];
+    }
+    float* yr = y.data.data() + r * d;
+    for (int k = 0; k < top_k; ++k) {
+      int ex = idx[k];
+      float g = gate[k] / sum;
+      const float* w1e = w1.second + static_cast<int64_t>(ex) * d * dff;
+      const float* b1e = b1.second + static_cast<int64_t>(ex) * dff;
+      for (int j = 0; j < dff; ++j) {
+        float s = b1e[j];
+        for (int i = 0; i < d; ++i)
+          s += xr[i] * w1e[static_cast<int64_t>(i) * dff + j];
+        hid[j] = std::tanh(s);
+      }
+      const float* w2e = w2.second + static_cast<int64_t>(ex) * dff * d;
+      const float* b2e = b2.second + static_cast<int64_t>(ex) * d;
+      for (int i = 0; i < d; ++i) {
+        float s = b2e[i];
+        for (int j = 0; j < dff; ++j)
+          s += hid[j] * w2e[static_cast<int64_t>(j) * d + i];
+        yr[i] += g * s;
+      }
+    }
+  }
+  return y;
+}
+
 // One pre-LN transformer block: x + causalMHA(ln1(x)), then
-// x + tanh(ln2(x) @ w_up + up_bias) @ w_down + down_bias.
+// x + tanh(ln2(x) @ w_up + up_bias) @ w_down + down_bias (or the MoE
+// FFN when the block carries expert params).
 // Plain tanh — NOT the scaled 1.7159 activation of the conv/FC stack.
 Tensor lm_block(const Tensor& x_in, const Layer& layer) {
   int n_heads = layer.config.at("n_heads").as_int();
@@ -514,10 +590,16 @@ Tensor lm_block(const Tensor& x_in, const Layer& layer) {
   int inner = wq.first[1];
   if (inner % n_heads != 0 || n_heads <= 0)
     throw std::runtime_error("lm_block: inner dim not divisible by heads");
-  const auto& wup = layer.params.at("w_up");
-  if (wup.first.size() != 2 || wup.first[0] != d)
-    throw std::runtime_error("lm_block: w_up must be [d_model, d_ff]");
-  int dff = wup.first[1];
+  bool is_moe = layer.params.count("moe_router") > 0;
+  if (!is_moe) {
+    const auto& wup = layer.params.at("w_up");
+    if (wup.first.size() != 2 || wup.first[0] != d)
+      throw std::runtime_error("lm_block: w_up must be [d_model, d_ff]");
+    int dff = wup.first[1];
+    check("up_bias", {dff});
+    check("w_down", {dff, d});
+    check("down_bias", {d});
+  }
   check("ln1_scale", {d});
   check("ln1_bias", {d});
   check("ln2_scale", {d});
@@ -525,9 +607,6 @@ Tensor lm_block(const Tensor& x_in, const Layer& layer) {
   check("wk", {d, inner});
   check("wv", {d, inner});
   check("wo", {inner, d});
-  check("up_bias", {dff});
-  check("w_down", {dff, d});
-  check("down_bias", {d});
   int hd = inner / n_heads;
   float scale = 1.0f / std::sqrt(static_cast<float>(hd));
 
@@ -586,11 +665,21 @@ Tensor lm_block(const Tensor& x_in, const Layer& layer) {
   Tensor h2 = x;
   layer_norm_rows(&h2, layer.params.at("ln2_scale").second,
                   layer.params.at("ln2_bias").second);
-  Tensor u = matmul_rows(h2, wup.second, layer.params.at("up_bias").second,
-                         d, dff);
-  for (auto& uv : u.data) uv = std::tanh(uv);
-  Tensor dn = matmul_rows(u, layer.params.at("w_down").second,
-                          layer.params.at("down_bias").second, dff, d);
+  Tensor dn;
+  if (is_moe) {
+    int top_k = layer.config.has("top_k")
+                    ? layer.config.at("top_k").as_int()
+                    : 1;
+    dn = moe_ffn(h2, layer, top_k);
+  } else {
+    const auto& wup = layer.params.at("w_up");
+    int dff = wup.first[1];
+    Tensor u = matmul_rows(h2, wup.second,
+                           layer.params.at("up_bias").second, d, dff);
+    for (auto& uv : u.data) uv = std::tanh(uv);
+    dn = matmul_rows(u, layer.params.at("w_down").second,
+                     layer.params.at("down_bias").second, dff, d);
+  }
   for (int64_t i = 0; i < x.size(); ++i) x.data[i] += dn.data[i];
   return x;
 }

@@ -236,3 +236,34 @@ class TestNativeLMInference:
         )
         assert r.returncode != 0
         assert "vocabulary" in r.stderr
+
+    def test_moe_lm_forward_matches_python(self, znicz_infer, tmp_path):
+        # MoE blocks deploy natively too: dense-dispatch gated experts in
+        # C++ must reproduce ops/moe.apply through the whole LM
+        from functools import partial
+
+        from znicz_tpu.export import export_lm_model
+        from znicz_tpu.workflow.transformer import init_lm_params, lm_apply
+
+        prng.seed_all(31)
+        vocab, d, heads, t = 17, 32, 4, 12
+        params = init_lm_params(vocab, d, 2, heads, max_seq=t, moe_experts=4)
+        tokens = np.random.default_rng(9).integers(
+            0, vocab, (3, t)
+        ).astype(np.int32)
+        y_py = np.asarray(
+            lm_apply(
+                params, jnp.asarray(tokens), n_heads=heads, moe_top_k=2
+            )
+        )
+
+        model_path = str(tmp_path / "moe_lm.znicz")
+        export_lm_model(params, model_path, n_heads=heads, moe_top_k=2)
+        in_path, out_path = str(tmp_path / "mi.f32"), str(tmp_path / "mo.f32")
+        tokens.astype(np.float32).tofile(in_path)
+        subprocess.run(
+            [znicz_infer, model_path, in_path, out_path, "3"],
+            check=True, capture_output=True,
+        )
+        y_cc = np.fromfile(out_path, np.float32).reshape(3, t, vocab)
+        np.testing.assert_allclose(y_cc, y_py, rtol=1e-4, atol=1e-4)

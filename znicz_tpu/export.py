@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -145,15 +145,21 @@ _LM_BLOCK_KEYS = (
 )
 
 
-def export_lm_model(params, path: str, *, n_heads: int) -> Dict[str, Any]:
+def export_lm_model(
+    params, path: str, *, n_heads: int, moe_top_k: Optional[int] = None
+) -> Dict[str, Any]:
     """Export a transformer LM for the native engine (SURVEY.md 2.4: the
     beyond-parity flagship deploys the way every parity model does).
 
     ``params``: the flat ``init_lm_params`` layout
     ``[embed, block_0..L-1, head]`` (``TransformerLMWorkflow.state.params``
-    for non-pipelined runs).  Artifact I/O: input = [T] token ids stored
-    as float32 in the raw file; output = [T, vocab] logits
-    (``output_kind="raw"`` — matches python ``lm_apply``).
+    for non-pipelined runs).  MoE blocks export too: ``moe_top_k`` is then
+    REQUIRED and must match the training config — the engine gates with
+    dense-dispatch semantics (every capacity-trained model serves fine
+    dense-gated at inference; there is no token dropping to reproduce).
+    Artifact I/O: input = [T] token ids stored as float32 in the raw
+    file; output = [T, vocab] logits (``output_kind="raw"`` — matches
+    python ``lm_apply``).
     """
     if not isinstance(params, (list, tuple)) or len(params) < 3:
         raise ValueError(
@@ -170,24 +176,31 @@ def export_lm_model(params, path: str, *, n_heads: int) -> Dict[str, Any]:
     ]
     from znicz_tpu.workflow.transformer import MOE_KEY_MAP
 
+    _FFN_KEYS = ("w_up", "up_bias", "w_down", "down_bias")
     for block in blocks:
-        if any(k in block for k in MOE_KEY_MAP):
-            raise ValueError(
-                "mixture-of-experts blocks are not implemented by the "
-                "native engine (native/znicz_infer.cc); export a dense-FFN "
-                "LM (moe_experts=0)"
-            )
         inner = int(np.asarray(block["wq"]).shape[1])
         if inner % n_heads:
             raise ValueError(
                 f"block inner dim {inner} not divisible by n_heads {n_heads}"
             )
+        config: Dict[str, Any] = {"n_heads": int(n_heads)}
+        if "moe_router" in block:
+            if moe_top_k is None:
+                # a silent default would gate differently than the model
+                # trained with (the exact mismatch this kwarg prevents)
+                raise ValueError(
+                    "this LM has mixture-of-experts blocks: pass "
+                    "moe_top_k=<the training top_k> so the native engine "
+                    "gates identically"
+                )
+            config["top_k"] = int(moe_top_k)
+            keys = [
+                k for k in _LM_BLOCK_KEYS if k not in _FFN_KEYS
+            ] + list(MOE_KEY_MAP)
+        else:
+            keys = list(_LM_BLOCK_KEYS)
         layer_arrays.append(
-            (
-                "lm_block",
-                {"n_heads": int(n_heads)},
-                {k: block[k] for k in _LM_BLOCK_KEYS},
-            )
+            ("lm_block", config, {k: block[k] for k in keys})
         )
     layer_arrays.append(("lm_head", {}, {"head": head["head"]}))
     return _write_artifact(
