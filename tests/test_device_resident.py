@@ -213,6 +213,29 @@ class TestPoolSharded:
             loader2.set_data_shards(8)
 
 
+class TestPoolShardedUnsupervised:
+    def test_som_trains_on_sharded_pool(self):
+        # the non-backprop families inherit pool sharding through the
+        # same centralized preproc: SOM trains on a data-axis-sharded pool
+        from znicz_tpu.parallel import DataParallel, make_mesh
+
+        prng.seed_all(91)
+        gen = np.random.default_rng(23)
+        data = gen.normal(0.0, 1.0, (128, 12)).astype(np.float32)
+        loader = FullBatchLoader(
+            {"train": data}, minibatch_size=32,
+            device_resident=True, pool_sharded=True,
+        )
+        wf = KohonenWorkflow(
+            loader, sx=3, sy=3, total_epochs=3, impl="xla",
+            parallel=DataParallel(make_mesh(8, 1)),
+        )
+        wf.initialize(seed=91)
+        assert wf._ctx["pool"].addressable_shards[0].data.shape[0] == 16
+        hist = wf.run().history
+        assert all(np.isfinite(h["train"]["loss"]) for h in hist)
+
+
 class TestAutoencoderDeviceResident:
     def test_target_is_preprocessed_input(self):
         # target="input": the AE target must be the PREPROCESSED batch (the

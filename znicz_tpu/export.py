@@ -185,13 +185,14 @@ def export_lm_model(
             )
         config: Dict[str, Any] = {"n_heads": int(n_heads)}
         if "moe_router" in block:
-            if moe_top_k is None:
-                # a silent default would gate differently than the model
-                # trained with (the exact mismatch this kwarg prevents)
+            if moe_top_k is None or int(moe_top_k) < 1:
+                # a silent default (or the engine's clamp of a degenerate
+                # value) would gate differently than the model trained
+                # with — the exact mismatch this kwarg prevents
                 raise ValueError(
                     "this LM has mixture-of-experts blocks: pass "
-                    "moe_top_k=<the training top_k> so the native engine "
-                    "gates identically"
+                    "moe_top_k=<the training top_k, >= 1> so the native "
+                    "engine gates identically"
                 )
             config["top_k"] = int(moe_top_k)
             keys = [
