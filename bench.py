@@ -414,9 +414,10 @@ def main() -> None:
     LM = dict(vocab=8192, d_model=256, n_layers=8, n_heads=8)
     LM_B = 8
     # mid config (~50M matmul params): shows MFU scaling with model size
-    # — d=256 matmuls are too small to fill the v5e MXU (tokens/s is flat
-    # from B=8 to B=32), so the small-model number is latency-bound, not
-    # framework-bound
+    # — d=256 matmuls are too small to tile the v5e MXU well; tokens/s is
+    # FLAT from B=8 to B=32 (step time scales with B — every extra row
+    # costs proportional time), so the small model is geometry/utilization
+    # -bound, not framework-bound
     LM_MID = dict(vocab=8192, d_model=512, n_layers=12, n_heads=8)
     LM_MID_B = 16
     lm_tokens = np.random.default_rng(6).integers(

@@ -497,6 +497,12 @@ struct Layer {
 // runs x @ w1 + b1 -> tanh -> @ w2 + b2, gate-weighted combine.
 // h [R, d] flattened tokens; params carry a leading expert dim.
 Tensor moe_ffn(const Tensor& h, const Layer& layer, int top_k) {
+  for (const char* name : {"moe_router", "moe_w_up", "moe_up_bias",
+                           "moe_w_down", "moe_down_bias"}) {
+    if (!layer.params.count(name))
+      throw std::runtime_error("moe: missing param '" + std::string(name) +
+                               "' (corrupt artifact?)");
+  }
   const auto& router = layer.params.at("moe_router");  // [d, E]
   const auto& w1 = layer.params.at("moe_w_up");        // [E, d, dff]
   const auto& b1 = layer.params.at("moe_up_bias");     // [E, dff]
@@ -588,7 +594,7 @@ Tensor lm_block(const Tensor& x_in, const Layer& layer) {
   if (wq.first.size() != 2 || wq.first[0] != d)
     throw std::runtime_error("lm_block: wq must be [d_model, inner]");
   int inner = wq.first[1];
-  if (inner % n_heads != 0 || n_heads <= 0)
+  if (n_heads <= 0 || inner % n_heads != 0)
     throw std::runtime_error("lm_block: inner dim not divisible by heads");
   bool is_moe = layer.params.count("moe_router") > 0;
   if (!is_moe) {
@@ -836,6 +842,8 @@ struct Model {
         x = lm_block(x, layer);
       } else if (t == "lm_head") {
         const auto& hp = layer.params.at("head");  // [d, vocab]
+        if (hp.first.size() != 2)
+          throw std::runtime_error("lm_head: head param must be rank-2");
         if (x.shape.size() != 3 || x.dim(2) != hp.first[0])
           throw std::runtime_error("lm_head: input dim mismatch");
         x = matmul_rows(x, hp.second, nullptr, hp.first[0], hp.first[1]);
