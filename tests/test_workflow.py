@@ -187,21 +187,51 @@ class TestDeferredEpochSync:
         assert final is not None
         assert wf.sync_epoch() is None  # idempotent
 
-    def test_best_snapshotter_rejected(self, tmp_path):
+    def test_best_snapshots_compose_exactly(self, tmp_path):
+        # deferred + save_best: improvement is only known one epoch late,
+        # so best saves write from the retained one-epoch state buffer —
+        # the written files must be BYTE-identical to a sync-mode run's
+        # (state, loader/prng host state, decision bookkeeping, all of it)
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
         from znicz_tpu.workflow.snapshotter import Snapshotter
 
-        with np.testing.assert_raises(ValueError):
-            Workflow(
-                loader=None, model=None,
-                snapshotter=Snapshotter(str(tmp_path)),
-                epoch_sync="deferred",
+        def run(epoch_sync, out_dir):
+            prng.seed_all(85)
+            gen = np.random.default_rng(21)
+            images = gen.integers(0, 256, (96, 8, 8, 1), dtype=np.uint8)
+            labels = (images.mean(axis=(1, 2, 3)) > 127).astype(np.int32)
+            loader = FullBatchLoader(
+                {"train": images}, {"train": labels}, minibatch_size=32,
+                normalization="range",
+                normalization_kwargs={"scale": 255.0, "shift": -0.5},
+                device_resident=True,
             )
-        with np.testing.assert_raises(ValueError):  # interval + best: still no
-            Workflow(
-                loader=None, model=None,
-                snapshotter=Snapshotter(str(tmp_path), interval=1),
-                epoch_sync="deferred",
+            wf = StandardWorkflow(
+                loader,
+                [{"type": "all2all_tanh",
+                  "->": {"output_sample_shape": 8}},
+                 {"type": "softmax", "->": {"output_sample_shape": 2}}],
+                decision_config={"max_epochs": 5},
+                default_hyper={"learning_rate": 0.1,
+                               "gradient_moment": 0.9},
+                epoch_sync=epoch_sync,
             )
+            # compress=False: gzip headers embed an mtime, which would
+            # defeat the byte-for-byte comparison
+            wf.snapshotter = Snapshotter(
+                str(out_dir), compress=False, interval=2
+            )
+            wf.initialize(seed=85)
+            wf.run()
+
+        run("sync", tmp_path / "sync")
+        run("deferred", tmp_path / "deferred")
+        for tag in ("best", "epoch1", "epoch3"):
+            s = (tmp_path / "sync" / f"workflow_{tag}.pickle").read_bytes()
+            d = (
+                tmp_path / "deferred" / f"workflow_{tag}.pickle"
+            ).read_bytes()
+            assert s == d, f"{tag} snapshot differs between sync/deferred"
 
     def test_interval_snapshots_compose_exactly(self, tmp_path):
         # interval epochs flush BEFORE the next dispatch, so the snapshot

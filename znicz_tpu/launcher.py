@@ -55,9 +55,8 @@ def make_parser() -> argparse.ArgumentParser:
                    help="resume training from this snapshot file")
     p.add_argument("--snapshot-interval", type=int, default=None,
                    metavar="K",
-                   help="also snapshot every K epochs (with --epoch-sync "
-                        "deferred this implies save_best=False: interval-"
-                        "only snapshots are the deferred-compatible kind)")
+                   help="also snapshot every K epochs (composes with "
+                        "best-model snapshots in both epoch-sync modes)")
     p.add_argument("--snapshot-dir", default=None,
                    help="write snapshots under this directory")
     p.add_argument("--data-parallel", action="store_true",
@@ -104,9 +103,8 @@ def make_parser() -> argparse.ArgumentParser:
                    choices=["sync", "deferred"],
                    help="deferred: overlap the per-epoch metric fetch with "
                         "the next epoch's dispatch (verdicts lag one epoch; "
-                        "stop decisions stay exact; snapshots must be "
-                        "interval-only: Snapshotter(interval=k, "
-                        "save_best=False))")
+                        "stop decisions stay exact; best-model snapshots "
+                        "write from a retained one-epoch buffer)")
     p.add_argument("--dry-run", action="store_true",
                    help="build and initialize the workflow, run nothing")
     p.add_argument("--verbose", action="store_true")
@@ -129,8 +127,6 @@ class Launcher(Logger):
         if getattr(self.args, "snapshot_interval", None):
             sc = dict(wf_kwargs.get("snapshot_config") or {})
             sc.setdefault("interval", self.args.snapshot_interval)
-            if self.args.epoch_sync == "deferred":
-                sc.setdefault("save_best", False)
             wf_kwargs["snapshot_config"] = sc
         if (
             getattr(self.args, "epoch_sync", None)

@@ -106,10 +106,10 @@ class Snapshotter:
         self.compress = compress
         self.interval = interval
         self.keep = keep
-        # save_best=False: interval-only snapshots.  Required under the
-        # workflow's deferred epoch sync: improvement is only known one
-        # epoch late (when the state has advanced), while interval epochs
-        # are known in advance and flushed synchronously.
+        # save_best=False: interval-only snapshots.  Under the workflow's
+        # deferred epoch sync, best saves write from a retained one-epoch
+        # state buffer (improvement is only known one epoch late); interval
+        # epochs are known in advance and flush synchronously.
         self.save_best = save_best
         # multi-host: the Workflow sets writer=False on non-coordinator
         # processes — they still participate in save()'s (possibly

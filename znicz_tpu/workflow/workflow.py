@@ -109,21 +109,16 @@ class Workflow(Logger):
             raise ValueError(
                 f"epoch_sync={epoch_sync!r}: want 'sync' or 'deferred'"
             )
-        if epoch_sync == "deferred" and snapshotter is not None:
-            # interval snapshots compose: interval epochs are known in
-            # advance, so run_epoch flushes them synchronously BEFORE the
-            # next dispatch while self.state is still that epoch's.
-            # Improvement-driven 'best' saves cannot — improvement is only
-            # known after the lagged fetch, when the state has advanced.
-            if not snapshotter.interval or snapshotter.save_best:
-                raise ValueError(
-                    "epoch_sync='deferred' needs interval-only snapshots: "
-                    "Snapshotter(interval=k, save_best=False) (improvement"
-                    "-driven saves would capture the NEXT epoch's params); "
-                    "or use epoch_sync='sync'"
-                )
         self.epoch_sync = epoch_sync
         self._pending_accs = None
+        # deferred + save_best: improvement is only known after the lagged
+        # fetch, when self.state has advanced one epoch — so each dispatch
+        # RETAINS a copy of its epoch's FULL TrainState (params + momentum:
+        # ~2x the param bytes in HBM, held one epoch) plus the loader/prng
+        # host state, and the best-snapshot writes from that buffer when
+        # the lagged verdict resolves.  Interval epochs are known in
+        # advance and still flush synchronously before dispatch.
+        self._retained = None
         self.services = []  # per-epoch observers: plotters, status, image saver
         self.name = name
         self.state: Optional[TrainState] = None
@@ -401,15 +396,6 @@ class Workflow(Logger):
                 multihost.process_index(), multihost.process_count()
             )
         if self.snapshotter is not None:
-            # mirror the constructor check: the snapshotter may have been
-            # assigned after construction (tests, launcher overrides)
-            if self.epoch_sync == "deferred" and (
-                not self.snapshotter.interval or self.snapshotter.save_best
-            ):
-                raise ValueError(
-                    "epoch_sync='deferred' needs interval-only snapshots: "
-                    "Snapshotter(interval=k, save_best=False)"
-                )
             self.snapshotter.writer = self._coordinator
         # host-side mirror of state.step: lr policies read it every minibatch
         # and must not force a device sync in the hot loop
@@ -542,16 +528,6 @@ class Workflow(Logger):
         # pending must resolve synchronously (BEFORE the next dispatch)
         # when its verdict could stop training, or when it is an interval-
         # snapshot epoch (self.state is still that epoch's right now)
-        if deferred and self.snapshotter is not None and (
-            not self.snapshotter.interval or self.snapshotter.save_best
-        ):
-            # also enforced at construction/initialize; this catches a
-            # snapshotter assigned after initialize(), which would
-            # otherwise silently write one-epoch-ahead train states
-            raise ValueError(
-                "epoch_sync='deferred' needs interval-only snapshots: "
-                "Snapshotter(interval=k, save_best=False)"
-            )
         pending_snapshots = (
             self.snapshotter is not None
             and self.snapshotter.interval
@@ -563,6 +539,9 @@ class Workflow(Logger):
             and (self.decision.can_stop_next_epoch() or pending_snapshots)
         ):
             accs, self._pending_accs = self._pending_accs, None
+            # self.state IS still the pending epoch's (nothing dispatched
+            # since), so the retained copy is redundant here — drop it
+            self._retained = None
             flushed = self._finish_epoch(accs)
             if flushed["stop"]:
                 return flushed  # nothing new dispatched
@@ -577,9 +556,29 @@ class Workflow(Logger):
             if hasattr(acc, "copy_to_host_async"):
                 acc.copy_to_host_async()
         prev, self._pending_accs = self._pending_accs, accs
+        prev_retained, self._retained = self._retained, (
+            self._retain_state()
+            if self.snapshotter is not None and self.snapshotter.save_best
+            else None
+        )
         if prev is not None:
+            if (
+                self.snapshotter is not None
+                and self.snapshotter.save_best
+                and prev_retained is None
+            ):
+                # a snapshotter assigned AFTER the pending epoch dispatched
+                # has no retained buffer for it — self.state is already one
+                # epoch ahead, and writing it as the pending epoch's 'best'
+                # would be silently wrong
+                raise ValueError(
+                    "snapshotter with save_best was assigned after an "
+                    "epoch dispatched under epoch_sync='deferred'; assign "
+                    "it before training starts (the retained state buffer "
+                    "is captured at dispatch time)"
+                )
             # guard above guarantees this verdict cannot be a stop
-            return self._finish_epoch(prev)
+            return self._finish_epoch(prev, retained=prev_retained)
         return flushed
 
     def sync_epoch(self) -> Optional[Dict[str, Any]]:
@@ -589,7 +588,25 @@ class Workflow(Logger):
         if self._pending_accs is None:
             return None
         accs, self._pending_accs = self._pending_accs, None
+        # nothing was dispatched after the pending epoch, so self.state is
+        # exactly that epoch's — the retained copy is redundant
+        self._retained = None
         return self._finish_epoch(accs)
+
+    def _retain_state(self):
+        """Copy of the CURRENT epoch's snapshot inputs, held until its
+        lagged verdict resolves under deferred sync with ``save_best``.
+
+        ``jnp.copy`` (not ``device_put``, which may alias) guarantees fresh
+        buffers: the next epoch's train step donates ``self.state``'s.  The
+        decision part of the host state is deliberately absent — it is only
+        correct AFTER the lagged ``on_epoch_end``, and is merged in at save
+        time by :meth:`_finish_epoch`."""
+        state = jax.tree_util.tree_map(jnp.copy, self.state)
+        return state, {
+            "loader": self.loader.state_dict(),
+            "prng": prng.state_dict(),
+        }
 
     def _run_epoch_stepwise(self) -> Dict[str, jax.Array]:
         accs: Dict[str, jax.Array] = {}  # per-split on-device accumulators
@@ -639,7 +656,9 @@ class Workflow(Logger):
                 accs[split] = acc
         return accs
 
-    def _finish_epoch(self, accs: Dict[str, jax.Array]) -> Dict[str, Any]:
+    def _finish_epoch(
+        self, accs: Dict[str, jax.Array], retained=None
+    ) -> Dict[str, Any]:
         with self.timer.phase("metrics_sync"):
             # one tiny existing-buffer fetch per split (no per-batch syncs)
             for split, acc in accs.items():
@@ -651,10 +670,22 @@ class Workflow(Logger):
         if self.snapshotter is not None:
             # called on EVERY process (the device->host readback may be a
             # collective for cross-host-sharded params); only the writer
-            # process (coordinator) touches the filesystem
+            # process (coordinator) touches the filesystem.  Under deferred
+            # sync with save_best, ``retained`` carries the epoch-N buffers
+            # (self.state already holds epoch N+1); key order matches
+            # host_state() so snapshot files are byte-identical to sync mode.
+            if retained is not None:
+                snap_state, host_extra = retained
+                snap_host = {
+                    "decision": self.decision.state_dict(),
+                    "loader": host_extra["loader"],
+                    "prng": host_extra["prng"],
+                }
+            else:
+                snap_state, snap_host = self.state, self.host_state()
             self.snapshotter.maybe_save(
-                self.state,
-                self.host_state(),
+                snap_state,
+                snap_host,
                 epoch=self.decision.epoch - 1,
                 improved=verdict["improved"],
             )
