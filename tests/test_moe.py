@@ -248,3 +248,28 @@ class TestMoE:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
         )
+
+    def test_local_shard_partials_sum_to_dense(self):
+        # apply_local_shard is the manual-EP building block for the PPxTP
+        # stage forward: the per-shard contributions must SUM to the dense
+        # dispatch exactly (that sum is the psum in _block_forward_tp)
+        params = self._params(e=8, f=8, h=16, seed=11)
+        x = jax.random.normal(jax.random.key(5), (12, 8))
+        ref = moe.apply(params, x, top_k=2)
+        n_shards = 4
+        e_local = 8 // n_shards
+        total = jnp.zeros_like(ref)
+        for s in range(n_shards):
+            local = {
+                "router": params["router"],  # replicated
+                **{
+                    k: params[k][s * e_local:(s + 1) * e_local]
+                    for k in ("w1", "b1", "w2", "b2")
+                },
+            }
+            total = total + moe.apply_local_shard(
+                local, x, top_k=2, shard_index=s
+            )
+        np.testing.assert_allclose(
+            np.asarray(total), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )

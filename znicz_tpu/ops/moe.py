@@ -104,24 +104,68 @@ def apply(
             "drop); lower top_k for capacity semantics",
             stacklevel=2,
         )
+    gates = _dense_gates(logits, top_k)
+    out = jnp.einsum(
+        "be,ebf->bf", gates, _dense_expert_outputs(params, x)
+    )
+    return out.astype(x.dtype)
+
+
+def _dense_gates(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """[B, E] top-k gate matrix: softmax over the top-k logits per token
+    (renormalized), zero elsewhere.  Shared by :func:`apply` and
+    :func:`apply_local_shard` so the two dispatch paths cannot drift."""
+    e = logits.shape[-1]
     if top_k >= e:
-        gates = jax.nn.softmax(logits, axis=-1)
-    else:
-        # exact top-k membership via indices (a >=threshold mask would
-        # activate EVERY tied expert — e.g. all of them for a zero row)
-        top_vals, top_idx = jax.lax.top_k(logits, top_k)
-        g = jax.nn.softmax(top_vals, axis=-1)  # [B, k]
-        onehot = jax.nn.one_hot(top_idx, e, dtype=g.dtype)  # [B, k, E]
-        gates = jnp.einsum("bk,bke->be", g, onehot)
-    # dense dispatch: every expert runs every token; gate combines.
+        return jax.nn.softmax(logits, axis=-1)
+    # exact top-k membership via indices (a >=threshold mask would
+    # activate EVERY tied expert — e.g. all of them for a zero row)
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)
+    g = jax.nn.softmax(top_vals, axis=-1)  # [B, k]
+    onehot = jax.nn.one_hot(top_idx, e, dtype=g.dtype)  # [B, k, E]
+    return jnp.einsum("bk,bke->be", g, onehot)
+
+
+def _dense_expert_outputs(params, x: jnp.ndarray) -> jnp.ndarray:
+    """[E, B, F] every expert's (biased) output for every token — the
+    dense-dispatch expert chain, shared by both dense paths."""
     h = jnp.einsum(
         "bf,efh->ebh", x, params["w1"], preferred_element_type=jnp.float32
     ) + params["b1"][:, None, :]
     h = jnp.tanh(h)
-    y = jnp.einsum(
+    return jnp.einsum(
         "ebh,ehf->ebf", h, params["w2"], preferred_element_type=jnp.float32
     ) + params["b2"][:, None, :]
-    out = jnp.einsum("be,ebf->bf", gates.astype(y.dtype), y)
+
+
+def apply_local_shard(
+    params_local: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,  # [B, F]
+    *,
+    top_k: int,
+    shard_index,
+) -> jnp.ndarray:
+    """ONE expert shard's dense-dispatch contribution, for MANUAL expert
+    parallelism inside a ``shard_map`` (the PPxTP stage forward, where
+    GSPMD cannot insert the combine psum for us).
+
+    ``params_local``'s expert leaves (w1/b1/w2/b2) hold this shard's
+    ``E_local = E / n_shards`` contiguous experts; the router is
+    REPLICATED, so the top-k gate over all ``E`` experts is computed
+    identically on every shard and this shard weights only its own gate
+    columns.  Gates partition over shards, so ``psum`` over the shard
+    axis reproduces :func:`apply`'s dense dispatch exactly (b2 is
+    gate-weighted per expert, so its partial sums correctly too).
+    ``shard_index`` may be a traced ``jax.lax.axis_index``.
+    """
+    logits = x @ params_local["router"]  # [B, E] — router replicated
+    e_local = params_local["w1"].shape[0]
+    gates_local = jax.lax.dynamic_slice_in_dim(
+        _dense_gates(logits, top_k), shard_index * e_local, e_local, axis=1
+    )
+    out = jnp.einsum(
+        "be,ebf->bf", gates_local, _dense_expert_outputs(params_local, x)
+    )
     return out.astype(x.dtype)
 
 

@@ -158,13 +158,17 @@ def _block_forward(block, x, *, n_heads, attention_fn=None,
     )
 
 
-def _block_forward_tp(block, x, *, n_heads_local, tp_axis, attention_fn=None):
+def _block_forward_tp(block, x, *, n_heads_local, tp_axis, attention_fn=None,
+                      moe_top_k=1):
     """:func:`_block_forward` for MANUAL (shard_map) tensor parallelism:
     the block's weights are model-axis-LOCAL shards (Megatron column
     placement for wq/wk/wv/w_up — so this device owns ``n_heads_local``
     heads and a 1/mp slice of the FFN — row placement for wo/w_down), and
     the two residual contributions are partial products ``psum``-ed over
-    ``tp_axis``.  Activations enter and leave replicated over the model
+    ``tp_axis``.  An MoE block shards its EXPERTS over ``tp_axis`` instead
+    (router replicated; :func:`znicz_tpu.ops.moe.apply_local_shard`
+    computes this shard's gate-weighted expert contribution, and the same
+    psum combines).  Activations enter and leave replicated over the model
     axis; same math as :func:`_block_forward` up to summation order.
     Used inside the pipeline's shard_map, where GSPMD cannot insert the
     collectives for us (SURVEY.md 2.5 beyond-parity: PPxTPxDP)."""
@@ -179,6 +183,17 @@ def _block_forward_tp(block, x, *, n_heads_local, tp_axis, attention_fn=None):
     )
     x = x + jax.lax.psum(att, tp_axis)
     h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
+    if "moe_router" in block:
+        from znicz_tpu.ops import moe as moe_op
+
+        b, t, d = h.shape
+        partial_y = moe_op.apply_local_shard(
+            {v: block[k] for k, v in MOE_KEY_MAP.items()},
+            h.reshape(b * t, d),
+            top_k=moe_top_k,
+            shard_index=jax.lax.axis_index(tp_axis),
+        )
+        return x + jax.lax.psum(partial_y.reshape(b, t, d), tp_axis)
     h = jnp.tanh(h @ block["w_up"] + block["up_bias"])
     return x + jax.lax.psum(h @ block["w_down"], tp_axis) + block["down_bias"]
 
@@ -256,6 +271,7 @@ def lm_apply_pipelined(
             n_heads_local=n_heads // n_model,
             tp_axis=tp_axis,
             attention_fn=attention_fn,
+            moe_top_k=moe_top_k,
         )
         param_spec_fn = _pp_stage_tp_specs(tp_axis)
     else:
@@ -300,12 +316,15 @@ def lm_pp_rules(path: str, leaf):
 def _stage_tp_spec(key: str, ndim: int, tp_axis: str = MODEL_AXIS):
     """PartitionSpec for ONE stacked stage leaf [S, ...] under PPxTP:
     stage dim over ``pipe``, weight dims per the Megatron role
-    (column: wq/wk/wv/w_up + up_bias; row: wo/w_down; rest replicated
-    over ``tp_axis``)."""
+    (column: wq/wk/wv/w_up + up_bias; row: wo/w_down; MoE expert leaves
+    shard their leading expert dim — manual EP; the router replicates);
+    the rest replicated over ``tp_axis``."""
     from jax.sharding import PartitionSpec as P
 
     from znicz_tpu.parallel.mesh import PIPE_AXIS
 
+    if key in _MOE_EXPERT_SHARDED:
+        return P(PIPE_AXIS, tp_axis, *([None] * (ndim - 2)))
     if key in ("wq", "wk", "wv", "w_up"):
         return P(PIPE_AXIS, None, tp_axis)
     if key in ("wo", "w_down"):
@@ -410,9 +429,14 @@ class TransformerLMWorkflow(Workflow):
         d_model: int = 64,
         n_layers: int = 2,
         n_heads: int = 4,
+        d_ff: Optional[int] = None,  # FFN/expert hidden size (default 4*d)
         max_epochs: int = 10,
         hyper: Optional[optimizer.HyperParams] = None,
         attention: str = "auto",  # "dot" | "flash" | "auto"
+        # "bf16": q/k/v cast to bf16 at the attention boundary — the MXU
+        # dots run bf16 with f32 accumulation (measured 1.2-1.5x on v5e);
+        # params/activations/softmax stay f32
+        attention_dtype: str = "f32",  # "f32" | "bf16"
         remat: bool = False,  # jax.checkpoint each block (long context)
         moe_experts: int = 0,  # >1: MoE FFN per block (ops/moe.py)
         moe_top_k: int = 1,
@@ -452,21 +476,32 @@ class TransformerLMWorkflow(Workflow):
         self.d_model = d_model
         self.n_layers = n_layers
         self.n_heads = n_heads
+        self.d_ff = d_ff
         self.hyper = hyper or optimizer.HyperParams(
             learning_rate=0.1, gradient_moment=0.9
         )
         self.rand_name = rand_name
         self.attention = attention
+        if attention_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"attention_dtype={attention_dtype!r}: want 'f32' or 'bf16'"
+            )
+        self.attention_dtype = attention_dtype
         self.remat = remat
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_dispatch = moe_dispatch
         if moe_experts > 1 and pipeline_parallel and tensor_parallel:
-            raise ValueError(
-                "moe_experts is not supported under pipeline+tensor "
-                "parallel (the manual-TP stage forward has no expert "
-                "collectives); use PP alone, TP alone, or DP x EP"
-            )
+            # manual EP inside the pipeline shard_map: experts shard over
+            # the model axis (apply_local_shard + the stage psum); only
+            # dense dispatch has the manual formulation
+            if moe_dispatch != "dense":
+                raise ValueError(
+                    "pipeline+tensor parallel MoE supports only "
+                    "moe_dispatch='dense' (experts shard over the model "
+                    "axis with a manual combine psum; capacity dispatch "
+                    "has no manual-EP formulation here)"
+                )
         self.sequence_parallel = sequence_parallel
         self.tensor_parallel = tensor_parallel
         self.pipeline_parallel = pipeline_parallel
@@ -515,6 +550,12 @@ class TransformerLMWorkflow(Workflow):
                     raise ValueError(
                         f"n_heads={n_heads} not divisible by model axis "
                         f"{n_model}"
+                    )
+                if moe_experts > 1 and moe_experts % n_model:
+                    raise ValueError(
+                        f"moe_experts={moe_experts} not divisible by model "
+                        f"axis {n_model} (experts shard over it under "
+                        "pipeline+tensor parallel)"
                     )
                 if self.parallel is None:
                     raise ValueError(
@@ -626,6 +667,27 @@ class TransformerLMWorkflow(Workflow):
         return fn
 
     def _attention_fn(self):
+        fn = self._attention_fn_base()
+        if self.attention_dtype != "bf16":
+            return fn
+        from znicz_tpu.ops import attention as att_op
+
+        base_fn = fn or att_op.dot_product_attention
+
+        def bf16_fn(q, k, v, **kw):
+            # cast at the boundary only: scores/softmax/accumulation stay
+            # f32 inside the kernel (or via preferred_element_type in the
+            # jnp twin); the output returns to the residual dtype
+            return base_fn(
+                q.astype(jnp.bfloat16),
+                k.astype(jnp.bfloat16),
+                v.astype(jnp.bfloat16),
+                **kw,
+            ).astype(q.dtype)
+
+        return bf16_fn
+
+    def _attention_fn_base(self):
         on_tpu = jax.default_backend() in ("tpu", "axon")
         if self.sequence_parallel:
             from znicz_tpu.parallel.ring_attention import ring_attention
@@ -758,6 +820,7 @@ class TransformerLMWorkflow(Workflow):
             self.n_layers,
             self.n_heads,
             self.max_seq,
+            d_ff=self.d_ff,
             moe_experts=self.moe_experts,
             rand_name=self.rand_name,
         )
