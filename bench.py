@@ -426,14 +426,21 @@ def main() -> None:
 
     def lm_train_flops_per_token(cfg) -> float:
         # matmul params (QKV+O, FFN, head — embed/pos are gathers/adds)
-        # x 2, plus attention scores+weighted-sum 4*T*D per layer per
-        # token; training ~ 3x forward (fwd + input-grad + weight-grad).
-        # remat recomputes fwd (~4x fwd) but MFU uses the remat-off run.
+        # x 2, plus CAUSAL attention scores+weighted-sum 2*T*D per layer
+        # per token (avg attended length T/2; the flash kernel skips the
+        # entirely-masked blocks, so counting the full bidirectional
+        # 4*T*D would inflate MFU ~1.2x at the mid config — the r4
+        # numbers did).  Training ~ 3x forward (fwd + input-grad +
+        # weight-grad); remat recomputes fwd (~4x) but MFU uses the
+        # remat-off run.  Convention reported as lm_flops_convention.
         d, L, v = cfg["d_model"], cfg["n_layers"], cfg["vocab"]
-        p_mat = L * (4 * d * d + 2 * d * (4 * d)) + d * v
-        return 3.0 * (2.0 * p_mat + 4.0 * L * LM_T * d)
+        d_ff = cfg.get("d_ff") or 4 * d
+        p_mat = L * (4 * d * d + 2 * d * d_ff) + d * v
+        return 3.0 * (2.0 * p_mat + 2.0 * L * LM_T * d)
 
-    def lm_rate(cfg, b, attention: str, remat: bool, tokens=None) -> float:
+    def lm_rate(
+        cfg, b, attention: str, remat: bool, tokens=None, extra=None
+    ) -> float:
         tokens = lm_tokens if tokens is None else tokens
         t_len = tokens.shape[1]
         prng.seed_all(99)
@@ -441,7 +448,8 @@ def main() -> None:
             {"train": tokens[: 2 * b].copy()}, minibatch_size=b
         )
         lwf = TransformerLMWorkflow(
-            ld, max_epochs=1, attention=attention, remat=remat, **cfg
+            ld, max_epochs=1, attention=attention, remat=remat,
+            **cfg, **(extra or {}),
         )
         lwf.initialize(seed=99)
         lx = jnp.asarray(tokens[:b])
@@ -470,11 +478,14 @@ def main() -> None:
         dt = min(timed() for _ in range(3)) / n_inner
         return b * t_len / dt
 
-    def lm_rate_safe(cfg, b, attention, remat, tokens=None) -> float:
+    def lm_rate_safe(
+        cfg, b, attention, remat, tokens=None, extra=None
+    ) -> float:
         # HBM headroom through the relay varies run to run — a failed LM
         # variant must degrade to 0.0, never kill the whole bench
         try:
-            return lm_rate(cfg, b, attention, remat, tokens=tokens)
+            return lm_rate(cfg, b, attention, remat, tokens=tokens,
+                           extra=extra)
         except Exception as e:
             print(
                 f"lm config d={cfg['d_model']} B={b} {attention} "
@@ -493,6 +504,79 @@ def main() -> None:
         lm_mid = lm_rate_safe(LM_MID, LM_MID_B, "flash", remat=False)
     lm_mid_mfu = lm_mid * lm_train_flops_per_token(LM_MID) / peak
 
+    # hd=128 variant (same d=512 tower, 4 heads x 128): tests the r4
+    # hypothesis that QK^T at head_dim 64 half-fills the MXU's 128-lane
+    # contraction dim.  Same matmul params, same counted FLOPs.
+    LM_HD128 = dict(LM_MID, n_heads=4)
+    lm_hd128 = lm_rate_safe(LM_HD128, LM_MID_B, "flash", remat=False)
+    lm_hd128_mfu = lm_hd128 * lm_train_flops_per_token(LM_HD128) / peak
+
+    # bf16 attention (q/k/v on the MXU in bf16, f32 accumulation): the r5
+    # kernel keeps input dtype — standalone fwd+full-bwd 12.7 -> 10.7 ms
+    # (hd64) / 6.0 -> 4.3 ms (hd128)
+    bf16 = dict(attention_dtype="bf16")
+    lm_mid_bf16 = lm_rate_safe(
+        LM_MID, LM_MID_B, "flash", remat=False, extra=bf16
+    )
+    lm_hd128_bf16 = lm_rate_safe(
+        LM_HD128, LM_MID_B, "flash", remat=False, extra=bf16
+    )
+    lm_hd128_bf16_mfu = (
+        lm_hd128_bf16 * lm_train_flops_per_token(LM_HD128) / peak
+    )
+
+    # MoE perf at matched ACTIVE FLOPs (VERDICT r4 weak #3): E=8 experts
+    # of d_ff=1024 at top_k=2 activate exactly the dense tower's
+    # d_ff=2048-worth of FFN FLOPs per token, so tokens/s is directly
+    # comparable to lm_mid.  Dense dispatch runs all 8 experts (4x the
+    # active FFN FLOPs — the "trades k/E of the FLOPs" cost made
+    # visible); capacity dispatch computes only the routed tokens.
+    LM_MOE = dict(LM_MID, d_ff=1024)
+    moe_kw = dict(moe_experts=8, moe_top_k=2)
+    lm_moe_dense = lm_rate_safe(
+        LM_MOE, LM_MID_B, "flash", remat=False,
+        extra=dict(moe_kw, moe_dispatch="dense"),
+    )
+    lm_moe_capacity = lm_rate_safe(
+        LM_MOE, LM_MID_B, "flash", remat=False,
+        extra=dict(moe_kw, moe_dispatch="capacity"),
+    )
+
+    # KV-cache decode (VERDICT r4 weak #2): greedy generation on the mid
+    # config — prefill 64-token prompts, decode 256 new tokens/row in ONE
+    # compiled lax.scan; rate counts generated tokens only.
+    from znicz_tpu.workflow.generate import generate as lm_generate
+
+    def lm_decode_rate(cfg, b, prompt_len, new_tokens) -> float:
+        from znicz_tpu.workflow.transformer import init_lm_params
+
+        prng.seed_all(97)
+        params = init_lm_params(
+            cfg["vocab"], cfg["d_model"], cfg["n_layers"], cfg["n_heads"],
+            max_seq=prompt_len + new_tokens,
+        )
+        prompt = jnp.asarray(
+            lm_tokens[:b, :prompt_len] % cfg["vocab"], jnp.int32
+        )
+        kw = dict(n_heads=cfg["n_heads"], max_new_tokens=new_tokens)
+        out = lm_generate(params, prompt, **kw)  # compile + warmup
+        _sync(out.astype(jnp.float32))
+
+        def timed():
+            t0 = time.time()
+            o = lm_generate(params, prompt, **kw)
+            _sync(o.astype(jnp.float32))
+            return time.time() - t0
+
+        dt = min(timed() for _ in range(3))
+        return b * new_tokens / dt
+
+    try:
+        lm_decode = lm_decode_rate(LM_MID, LM_MID_B, 64, 256)
+    except Exception as e:
+        print(f"lm decode failed: {type(e).__name__}", file=sys.stderr)
+        lm_decode = 0.0
+
     # long context: flash (O(T*D) memory) + remat train the mid model at
     # 8x the headline sequence length on ONE chip — dense attention OOMs
     # at T=2048 already.  T=16384, B=2 (32k tokens/step, same as mid).
@@ -505,9 +589,14 @@ def main() -> None:
     )
     print(
         f"LM GPT-small T={LM_T}: flash {lm_flash:.0f} tok/s "
-        f"(MFU {lm_mfu:.3f}), dense {lm_dense:.0f}, "
+        f"(causal MFU {lm_mfu:.3f}), dense {lm_dense:.0f}, "
         f"flash+remat {lm_flash_remat:.0f}; "
         f"mid 512dx12L: {lm_mid:.0f} tok/s (MFU {lm_mid_mfu:.3f}); "
+        f"hd128 4Hx128: {lm_hd128:.0f} tok/s (MFU {lm_hd128_mfu:.3f}); "
+        f"bf16-attn mid {lm_mid_bf16:.0f} / hd128 {lm_hd128_bf16:.0f} "
+        f"tok/s (MFU {lm_hd128_bf16_mfu:.3f}); "
+        f"moe E=8 k=2 dense {lm_moe_dense:.0f} / capacity "
+        f"{lm_moe_capacity:.0f} tok/s; decode {lm_decode:.0f} tok/s; "
         f"long T={LM_LONG_T}: {lm_long:.0f} tok/s",
         file=sys.stderr,
     )
@@ -588,6 +677,46 @@ def main() -> None:
                 ),
                 "lm_mid_tokens_per_sec": round(lm_mid, 1),
                 "lm_mid_mfu": round(lm_mid_mfu, 4),
+                # MFU accounting counts CAUSAL attention (2*L*T*D per
+                # token — avg attended length T/2, matching what the
+                # flash kernel actually computes), not bidirectional
+                "lm_flops_convention": "causal_attention_2LTD",
+                "lm_hd128_config": (
+                    f"{LM_HD128['d_model']}d x {LM_HD128['n_layers']}L x "
+                    f"4H(hd=128), T={LM_T}, B={LM_MID_B}"
+                ),
+                "lm_hd128_tokens_per_sec": round(lm_hd128, 1),
+                "lm_hd128_mfu": round(lm_hd128_mfu, 4),
+                "lm_hd128_vs_mid": round(
+                    lm_hd128 / lm_mid if lm_mid else 0.0, 4
+                ),
+                "lm_mid_bf16_attn_tokens_per_sec": round(lm_mid_bf16, 1),
+                "lm_hd128_bf16_attn_tokens_per_sec": round(
+                    lm_hd128_bf16, 1
+                ),
+                "lm_hd128_bf16_attn_mfu": round(lm_hd128_bf16_mfu, 4),
+                "lm_best_vs_r4_mid": round(
+                    max(lm_hd128_bf16, lm_hd128, lm_mid_bf16, lm_mid)
+                    / 134730.3,
+                    4,
+                ),
+                "lm_moe_config": (
+                    "mid tower, E=8 experts d_ff=1024 top_k=2 "
+                    "(active FFN FLOPs == dense d_ff=2048)"
+                ),
+                "lm_moe_dense_tokens_per_sec": round(lm_moe_dense, 1),
+                "lm_moe_capacity_tokens_per_sec": round(lm_moe_capacity, 1),
+                "lm_moe_dense_vs_dense_ffn": round(
+                    lm_moe_dense / lm_mid if lm_mid else 0.0, 4
+                ),
+                "lm_moe_capacity_vs_dense_ffn": round(
+                    lm_moe_capacity / lm_mid if lm_mid else 0.0, 4
+                ),
+                "lm_decode_config": (
+                    "mid config, greedy KV-cache decode: prompt 64, "
+                    f"256 new tokens, B={LM_MID_B}, one lax.scan"
+                ),
+                "lm_decode_tokens_per_sec": round(lm_decode, 1),
                 "lm_long_context": (
                     f"mid config at T={LM_LONG_T}, B={LM_LONG_B}, "
                     "flash+remat (dense OOMs at T=2048 already)"

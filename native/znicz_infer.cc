@@ -17,6 +17,7 @@
 #include <cstring>
 #include <fstream>
 #include <iostream>
+#include <limits>
 #include <map>
 #include <memory>
 #include <sstream>
@@ -450,9 +451,9 @@ Tensor matmul_rows(const Tensor& x, const float* w, const float* b,
 
 // token ids (rounded from f32 input) [N, T] -> embed[id] + pos[t], [N, T, D]
 Tensor lm_embed(const Tensor& x, const float* embed, int vocab,
-                const float* pos, int max_seq, int d) {
+                const float* pos, int max_seq, int d, int offset = 0) {
   int n = x.dim(0), t = x.dim(1);
-  if (t > max_seq)
+  if (offset + t > max_seq)
     throw std::runtime_error("lm_embed: sequence longer than max_seq");
   Tensor y;
   y.shape = {n, t, d};
@@ -463,12 +464,20 @@ Tensor lm_embed(const Tensor& x, const float* embed, int vocab,
       if (id < 0 || id >= vocab)
         throw std::runtime_error("lm_embed: token id out of vocabulary");
       const float* e = embed + static_cast<int64_t>(id) * d;
-      const float* p = pos + static_cast<int64_t>(ti) * d;
+      const float* p = pos + static_cast<int64_t>(offset + ti) * d;
       float* out = y.data.data() + (static_cast<int64_t>(ni) * t + ti) * d;
       for (int i = 0; i < d; ++i) out[i] = e[i] + p[i];
     }
   return y;
 }
+
+// Per-block K/V cache for incremental decoding: [n, t_max, inner] rows,
+// written as positions are consumed (the deployment-side twin of
+// znicz_tpu/workflow/generate.py's init_kv_cache).
+struct KVCache {
+  int t_max = 0;
+  std::vector<float> k, v;
+};
 
 void softmax_rows(Tensor* t) {
   int c = t->shape.back();
@@ -576,7 +585,13 @@ Tensor moe_ffn(const Tensor& h, const Layer& layer, int top_k) {
 // x + tanh(ln2(x) @ w_up + up_bias) @ w_down + down_bias (or the MoE
 // FFN when the block carries expert params).
 // Plain tanh — NOT the scaled 1.7159 activation of the conv/FC stack.
-Tensor lm_block(const Tensor& x_in, const Layer& layer) {
+// With ``cache`` set, the block runs INCREMENTALLY: x_in holds positions
+// ``offset .. offset+t-1``, the new K/V rows append into the cache, and
+// attention reads the cache prefix (<= absolute query position) instead of
+// recomputing the full [T x T] score matrix per forward.  cache == nullptr
+// is the original full-sequence forward, bit-for-bit unchanged.
+Tensor lm_block(const Tensor& x_in, const Layer& layer,
+                KVCache* cache = nullptr, int offset = 0) {
   int n_heads = layer.config.at("n_heads").as_int();
   int n = x_in.dim(0), t = x_in.dim(1), d = x_in.dim(2);
   // Validate EVERY param's shape against the activation dims before any
@@ -623,22 +638,49 @@ Tensor lm_block(const Tensor& x_in, const Layer& layer) {
   Tensor k = matmul_rows(h, layer.params.at("wk").second, nullptr, d, inner);
   Tensor v = matmul_rows(h, layer.params.at("wv").second, nullptr, d, inner);
 
+  // key/value source: the fresh projections (full forward) or the cache
+  // with this call's rows appended (incremental decode)
+  const float* ksrc = k.data.data();
+  const float* vsrc = v.data.data();
+  int kv_stride = t;  // row stride per sample in the K/V source
+  int kv_offset = 0;  // absolute position of x_in's first row
+  if (cache) {
+    if (offset + t > cache->t_max)
+      throw std::runtime_error("lm_block: decode past the cache capacity");
+    for (int ni = 0; ni < n; ++ni)
+      for (int ti = 0; ti < t; ++ti) {
+        int64_t src = (static_cast<int64_t>(ni) * t + ti) * inner;
+        int64_t dst =
+            (static_cast<int64_t>(ni) * cache->t_max + offset + ti) * inner;
+        std::memcpy(cache->k.data() + dst, k.data.data() + src,
+                    inner * sizeof(float));
+        std::memcpy(cache->v.data() + dst, v.data.data() + src,
+                    inner * sizeof(float));
+      }
+    ksrc = cache->k.data();
+    vsrc = cache->v.data();
+    kv_stride = cache->t_max;
+    kv_offset = offset;
+  }
+
   // causal softmax attention per (batch, head); layouts are head-major
-  // within the inner dim (mha's reshape(b, t, heads, hd))
+  // within the inner dim (mha's reshape(b, t, heads, hd)); key positions
+  // run to the ABSOLUTE query position (== tq for the full forward)
   Tensor att;
   att.shape = {n, t, inner};
   att.data.assign(static_cast<size_t>(n) * t * inner, 0.0f);
-  std::vector<float> p(t);
+  std::vector<float> p(kv_offset + t);
   for (int ni = 0; ni < n; ++ni) {
     for (int hh = 0; hh < n_heads; ++hh) {
       for (int tq = 0; tq < t; ++tq) {
         const float* qrow =
             q.data.data() + (static_cast<int64_t>(ni) * t + tq) * inner +
             static_cast<int64_t>(hh) * hd;
+        int t_keys = kv_offset + tq;  // inclusive causal bound
         float mx = -1e30f;
-        for (int tk = 0; tk <= tq; ++tk) {
+        for (int tk = 0; tk <= t_keys; ++tk) {
           const float* krow =
-              k.data.data() + (static_cast<int64_t>(ni) * t + tk) * inner +
+              ksrc + (static_cast<int64_t>(ni) * kv_stride + tk) * inner +
               static_cast<int64_t>(hh) * hd;
           float s = 0.0f;
           for (int i = 0; i < hd; ++i) s += qrow[i] * krow[i];
@@ -646,17 +688,17 @@ Tensor lm_block(const Tensor& x_in, const Layer& layer) {
           mx = std::max(mx, p[tk]);
         }
         float sum = 0.0f;
-        for (int tk = 0; tk <= tq; ++tk) {
+        for (int tk = 0; tk <= t_keys; ++tk) {
           p[tk] = std::exp(p[tk] - mx);
           sum += p[tk];
         }
         float* out =
             att.data.data() + (static_cast<int64_t>(ni) * t + tq) * inner +
             static_cast<int64_t>(hh) * hd;
-        for (int tk = 0; tk <= tq; ++tk) {
+        for (int tk = 0; tk <= t_keys; ++tk) {
           float w = p[tk] / sum;
           const float* vrow =
-              v.data.data() + (static_cast<int64_t>(ni) * t + tk) * inner +
+              vsrc + (static_cast<int64_t>(ni) * kv_stride + tk) * inner +
               static_cast<int64_t>(hh) * hd;
           for (int i = 0; i < hd; ++i) out[i] += w * vrow[i];
         }
@@ -858,6 +900,114 @@ struct Model {
     }
     return x;
   }
+
+  // Greedy KV-cache decoding: prompt [n, tp] token ids -> [n, tp + n_new]
+  // (prompt included).  Prefill runs the prompt once, filling each block's
+  // cache; every further token is ONE cached block-tower step — the
+  // deployment twin of workflow/generate.py's generate(temperature=0).
+  Tensor generate(const Tensor& prompt, int n_new) const {
+    if (layers.size() < 3 || layers.front().type != "lm_embed" ||
+        layers.back().type != "lm_head")
+      throw std::runtime_error(
+          "generate: artifact is not an LM (want lm_embed .. lm_head)");
+    for (size_t i = 1; i + 1 < layers.size(); ++i)
+      if (layers[i].type != "lm_block")
+        throw std::runtime_error(
+            "generate: non-lm_block layer inside the tower");
+    if (n_new < 1)
+      throw std::runtime_error("generate: need n_new >= 1");
+    int n = prompt.dim(0), tp = prompt.dim(1);
+    int t_max = tp + n_new;
+    const auto& ep = layers.front().params.at("embed");  // [vocab, d]
+    const auto& pp = layers.front().params.at("pos");    // [max_seq, d]
+    if (ep.first.size() != 2 || pp.first.size() != 2 ||
+        pp.first[1] != ep.first[1])
+      throw std::runtime_error(
+          "lm_embed: embed/pos tables disagree on d_model "
+          "(corrupt artifact?)");
+    int vocab = ep.first[0], d = ep.first[1];
+    if (t_max > pp.first[0])
+      throw std::runtime_error(
+          "generate: prompt + n_new exceeds the positional table (" +
+          std::to_string(pp.first[0]) + ")");
+    int n_blocks = static_cast<int>(layers.size()) - 2;
+    std::vector<KVCache> caches(n_blocks);
+    for (int i = 0; i < n_blocks; ++i) {
+      const auto& wq = layers[1 + i].params.at("wq");
+      if (wq.first.size() != 2)
+        throw std::runtime_error("lm_block: wq must be [d_model, inner]");
+      int inner = wq.first[1];
+      caches[i].t_max = t_max;
+      caches[i].k.assign(
+          static_cast<size_t>(n) * t_max * inner, 0.0f);
+      caches[i].v.assign(
+          static_cast<size_t>(n) * t_max * inner, 0.0f);
+    }
+    Tensor out;
+    out.shape = {n, t_max};
+    out.data.resize(static_cast<size_t>(n) * t_max);
+    for (int ni = 0; ni < n; ++ni)
+      std::memcpy(out.data.data() + static_cast<int64_t>(ni) * t_max,
+                  prompt.data.data() + static_cast<int64_t>(ni) * tp,
+                  tp * sizeof(float));
+
+    const auto& hp = layers.back().params.at("head");  // [d, vocab]
+    if (hp.first.size() != 2 || hp.first[0] != d || hp.first[1] != vocab)
+      throw std::runtime_error("lm_head: head param shape mismatch");
+    auto greedy_from_last = [&](const Tensor& x, std::vector<float>* tok) {
+      // logits for the LAST position only: row-major [n,1,d]x[d,vocab]
+      // through matmul_rows (contiguous weight reads — this runs once per
+      // generated token), then argmax
+      int t = x.dim(1);
+      Tensor last;
+      last.shape = {n, 1, d};
+      last.data.resize(static_cast<size_t>(n) * d);
+      for (int ni = 0; ni < n; ++ni)
+        std::memcpy(
+            last.data.data() + static_cast<int64_t>(ni) * d,
+            x.data.data() + (static_cast<int64_t>(ni) * t + t - 1) * d,
+            d * sizeof(float));
+      Tensor logits = matmul_rows(last, hp.second, nullptr, d, vocab);
+      tok->resize(n);
+      for (int ni = 0; ni < n; ++ni) {
+        const float* lr =
+            logits.data.data() + static_cast<int64_t>(ni) * vocab;
+        int best = 0;
+        float best_v = -std::numeric_limits<float>::infinity();
+        for (int vv = 0; vv < vocab; ++vv)
+          if (lr[vv] > best_v) { best_v = lr[vv]; best = vv; }
+        (*tok)[ni] = static_cast<float>(best);
+      }
+    };
+
+    // prefill
+    Tensor x = lm_embed(prompt, ep.second, vocab, pp.second, pp.first[0],
+                        d, 0);
+    for (int i = 0; i < n_blocks; ++i)
+      x = lm_block(x, layers[1 + i], &caches[i], 0);
+    std::vector<float> tok;
+    greedy_from_last(x, &tok);
+    for (int ni = 0; ni < n; ++ni)
+      out.data[static_cast<int64_t>(ni) * t_max + tp] = tok[ni];
+
+    // decode: one position per step through the cached tower
+    Tensor step_in;
+    step_in.shape = {n, 1};
+    step_in.data.resize(n);
+    for (int s = 1; s < n_new; ++s) {
+      int pos = tp + s - 1;  // position of the token being consumed
+      for (int ni = 0; ni < n; ++ni)
+        step_in.data[ni] = out.data[static_cast<int64_t>(ni) * t_max + pos];
+      Tensor xs = lm_embed(step_in, ep.second, vocab, pp.second,
+                           pp.first[0], d, pos);
+      for (int i = 0; i < n_blocks; ++i)
+        xs = lm_block(xs, layers[1 + i], &caches[i], pos);
+      greedy_from_last(xs, &tok);
+      for (int ni = 0; ni < n; ++ni)
+        out.data[static_cast<int64_t>(ni) * t_max + pos + 1] = tok[ni];
+    }
+    return out;
+  }
 };
 
 }  // namespace
@@ -865,7 +1015,11 @@ struct Model {
 int main(int argc, char** argv) {
   if (argc < 3) {
     std::cerr << "usage: " << argv[0]
-              << " MODEL.znicz (INPUT.f32 OUTPUT.f32 [batch] | --describe)\n";
+              << " MODEL.znicz (INPUT.f32 OUTPUT.f32 [batch"
+                 " [--generate N]] | --describe)\n"
+              << "  --generate N: greedy KV-cache decode of N new tokens"
+                 " from the [batch, Tp] prompt in INPUT.f32 (LM artifacts"
+                 " only); OUTPUT.f32 gets [batch, Tp+N] token ids\n";
     return 2;
   }
   try {
@@ -883,20 +1037,51 @@ int main(int argc, char** argv) {
       std::cerr << "missing OUTPUT.f32\n";
       return 2;
     }
-    int batch = argc > 4 ? std::atoi(argv[4]) : 1;
-    int64_t per_sample = 1;
-    for (int d : model.input_shape) per_sample *= d;
-    Tensor x;
-    x.shape = {batch};
-    for (int d : model.input_shape) x.shape.push_back(d);
-    x.data.resize(batch * per_sample);
+    int batch = 1, n_generate = 0;
+    for (int a = 4; a < argc; ++a) {
+      std::string arg = argv[a];
+      if (arg == "--generate") {
+        if (a + 1 >= argc)
+          throw std::runtime_error("--generate needs a count");
+        n_generate = std::atoi(argv[++a]);
+        if (n_generate < 1)
+          throw std::runtime_error("--generate wants N >= 1");
+      } else if (a == 4) {
+        batch = std::atoi(arg.c_str());
+        if (batch < 1)  // also catches a mistyped flag landing here
+          throw std::runtime_error(
+              "batch must be a positive integer, got '" + arg + "'");
+      } else {
+        throw std::runtime_error("unrecognized argument: " + arg);
+      }
+    }
     std::ifstream in(argv[2], std::ios::binary);
     if (!in) throw std::runtime_error(std::string("cannot open ") + argv[2]);
+    Tensor x;
+    if (n_generate) {
+      // prompt length is whatever the file holds: [batch, Tp] token ids
+      in.seekg(0, std::ios::end);
+      int64_t bytes = in.tellg();
+      in.seekg(0, std::ios::beg);
+      int64_t floats = bytes / static_cast<int64_t>(sizeof(float));
+      if (floats <= 0 || floats % batch)
+        throw std::runtime_error(
+            "prompt file size not divisible by batch");
+      x.shape = {batch, static_cast<int>(floats / batch)};
+      x.data.resize(floats);
+    } else {
+      int64_t per_sample = 1;
+      for (int d : model.input_shape) per_sample *= d;
+      x.shape = {batch};
+      for (int d : model.input_shape) x.shape.push_back(d);
+      x.data.resize(batch * per_sample);
+    }
     in.read(reinterpret_cast<char*>(x.data.data()),
             x.data.size() * sizeof(float));
     if (in.gcount() != static_cast<std::streamsize>(x.data.size() * sizeof(float)))
       throw std::runtime_error("input file too small for batch");
-    Tensor y = model.forward(std::move(x));
+    Tensor y = n_generate ? model.generate(x, n_generate)
+                          : model.forward(std::move(x));
     std::ofstream out(argv[3], std::ios::binary);
     out.write(reinterpret_cast<const char*>(y.data.data()),
               y.data.size() * sizeof(float));

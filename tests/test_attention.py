@@ -203,3 +203,82 @@ class TestRingFlashInner:
         q, k, v = _qkv(b=1, t=16, h=1, d=8)
         with pytest.raises(ValueError, match="inner"):
             ring_attention(q, k, v, mesh=mesh, inner="blockwise")
+
+
+class TestBf16FlashKernel:
+    def test_bf16_flash_matches_f32_twin(self):
+        # the kernel keeps input dtype on the MXU; bf16 q/k/v must still
+        # reproduce the f32 jnp twin within bf16 mantissa tolerance
+        from znicz_tpu.ops.pallas.attention import flash_attention
+
+        q, k, v = _qkv(b=2, t=128, h=2, d=32, seed=7)
+        ref = attention.dot_product_attention(q, k, v, causal=True)
+        out = flash_attention(
+            q.astype(jnp.bfloat16),
+            k.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16),
+            causal=True,
+        )
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref),
+            rtol=3e-2, atol=3e-2,
+        )
+
+    def test_bf16_flash_grads_close_to_f32(self):
+        from znicz_tpu.ops.pallas.attention import flash_attention
+
+        q, k, v = _qkv(b=1, t=64, h=2, d=16, seed=9)
+
+        def loss(fn, qkv):
+            return jnp.sum(
+                jnp.square(fn(*qkv, causal=True).astype(jnp.float32))
+            )
+
+        g_ref = jax.grad(
+            lambda t: loss(attention.dot_product_attention, t)
+        )((q, k, v))
+        g_bf = jax.grad(lambda t: loss(flash_attention, t))(
+            tuple(x.astype(jnp.bfloat16) for x in (q, k, v))
+        )
+        for a, b in zip(g_ref, g_bf):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b, np.float32),
+                rtol=6e-2, atol=6e-2,
+            )
+
+
+class TestAttentionDtypeKnob:
+    def test_bf16_attention_trains_close_to_f32(self):
+        from znicz_tpu.core import prng
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.workflow.transformer import TransformerLMWorkflow
+
+        tokens = np.random.default_rng(3).integers(
+            0, 16, (32, 64)
+        ).astype(np.int32)
+
+        def run(dtype):
+            prng.seed_all(61)
+            ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=16)
+            wf = TransformerLMWorkflow(
+                ld, vocab=16, d_model=32, n_layers=2, n_heads=2,
+                max_epochs=2, attention="flash", attention_dtype=dtype,
+            )
+            wf.initialize(seed=61)
+            return [h["train"]["loss"] for h in wf.run().history]
+
+        f32 = run("f32")
+        bf16 = run("bf16")
+        np.testing.assert_allclose(f32, bf16, rtol=2e-2)
+
+    def test_invalid_attention_dtype_rejected(self):
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.workflow.transformer import TransformerLMWorkflow
+
+        tokens = np.zeros((8, 16), np.int32)
+        ld = FullBatchLoader({"train": tokens}, minibatch_size=4)
+        with pytest.raises(ValueError, match="attention_dtype"):
+            TransformerLMWorkflow(
+                ld, vocab=4, attention_dtype="fp8"
+            )

@@ -1,0 +1,130 @@
+"""KV-cache autoregressive decode vs the full forward (golden parity).
+
+The decode path (znicz_tpu/workflow/generate.py) must reproduce
+``lm_apply``'s logits position-by-position — prefill and incremental steps
+both — and ``generate`` must emit exactly the tokens a full re-forward
+would choose (greedy) while never re-running earlier positions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from znicz_tpu.core import prng
+from znicz_tpu.workflow import generate as G
+from znicz_tpu.workflow.transformer import init_lm_params, lm_apply
+
+
+def _setup(moe_experts=0, seed=27, t_max=24):
+    prng.seed_all(seed)
+    vocab, d, heads = 17, 32, 4
+    params = init_lm_params(
+        vocab, d, 2, heads, max_seq=t_max, moe_experts=moe_experts
+    )
+    tokens = np.random.default_rng(7).integers(
+        0, vocab, (3, 12)
+    ).astype(np.int32)
+    return params, tokens, heads, vocab
+
+
+class TestDecodeGolden:
+    def test_teacher_forced_logits_match_full_forward(self):
+        params, tokens, heads, _ = _setup()
+        full = np.asarray(lm_apply(params, jnp.asarray(tokens), n_heads=heads))
+        caches = G.init_kv_cache(params, 3, 12, n_heads=heads)
+        caches, lg = G.prefill(
+            params, jnp.asarray(tokens[:, :4]), caches, n_heads=heads
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg), full[:, 3], rtol=1e-4, atol=1e-5
+        )
+        for p in range(4, 12):
+            caches, lg = G.decode_step(
+                params, caches, jnp.asarray(tokens[:, p]), p, n_heads=heads
+            )
+            np.testing.assert_allclose(
+                np.asarray(lg), full[:, p], rtol=1e-4, atol=1e-5
+            )
+
+    def test_moe_decode_matches_full_forward(self):
+        # the MoE FFN rides the same _block_ffn in both paths
+        params, tokens, heads, _ = _setup(moe_experts=4, seed=31)
+        full = np.asarray(
+            lm_apply(params, jnp.asarray(tokens), n_heads=heads, moe_top_k=2)
+        )
+        caches = G.init_kv_cache(params, 3, 12, n_heads=heads)
+        caches, lg = G.prefill(
+            params, jnp.asarray(tokens[:, :6]), caches,
+            n_heads=heads, moe_top_k=2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg), full[:, 5], rtol=1e-4, atol=1e-5
+        )
+        for p in range(6, 12):
+            caches, lg = G.decode_step(
+                params, caches, jnp.asarray(tokens[:, p]), p,
+                n_heads=heads, moe_top_k=2,
+            )
+            np.testing.assert_allclose(
+                np.asarray(lg), full[:, p], rtol=1e-4, atol=1e-5
+            )
+
+    def test_greedy_generate_matches_full_reforward(self):
+        # every emitted token == the argmax a full forward over the
+        # (prompt + generated-so-far) prefix would choose
+        params, tokens, heads, _ = _setup()
+        out = np.asarray(
+            G.generate(
+                params, jnp.asarray(tokens[:, :4]),
+                n_heads=heads, max_new_tokens=8,
+            )
+        )
+        assert out.shape == (3, 12)
+        assert (out[:, :4] == tokens[:, :4]).all()
+        full = np.asarray(lm_apply(params, jnp.asarray(out), n_heads=heads))
+        for p in range(4, 12):
+            np.testing.assert_array_equal(
+                out[:, p], np.argmax(full[:, p - 1], axis=-1)
+            )
+
+    def test_temperature_sampling_reproducible_and_in_vocab(self):
+        params, tokens, heads, vocab = _setup()
+        kw = dict(n_heads=heads, max_new_tokens=6, temperature=0.8)
+        a = np.asarray(
+            G.generate(
+                params, jnp.asarray(tokens[:, :4]),
+                rng=jax.random.key(5), **kw,
+            )
+        )
+        b = np.asarray(
+            G.generate(
+                params, jnp.asarray(tokens[:, :4]),
+                rng=jax.random.key(5), **kw,
+            )
+        )
+        np.testing.assert_array_equal(a, b)  # same key -> same draw
+        assert (a[:, 4:] >= 0).all() and (a[:, 4:] < vocab).all()
+        c = np.asarray(
+            G.generate(
+                params, jnp.asarray(tokens[:, :4]),
+                rng=jax.random.key(6), **kw,
+            )
+        )
+        assert not (a == c).all()  # different key -> different draw
+
+    def test_capacity_exceeded_raises(self):
+        params, tokens, heads, _ = _setup(t_max=10)
+        with pytest.raises(ValueError, match="positional table"):
+            G.generate(
+                params, jnp.asarray(tokens[:, :4]),
+                n_heads=heads, max_new_tokens=8,
+            )
+
+    def test_temperature_without_rng_raises(self):
+        params, tokens, heads, _ = _setup()
+        with pytest.raises(ValueError, match="rng"):
+            G.generate(
+                params, jnp.asarray(tokens[:, :4]),
+                n_heads=heads, max_new_tokens=2, temperature=0.7,
+            )

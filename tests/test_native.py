@@ -267,3 +267,103 @@ class TestNativeLMInference:
         )
         y_cc = np.fromfile(out_path, np.float32).reshape(3, t, vocab)
         np.testing.assert_allclose(y_cc, y_py, rtol=1e-4, atol=1e-4)
+
+
+class TestNativeLMDecode:
+    def test_generate_matches_python_greedy(self, znicz_infer, tmp_path):
+        # the C++ --generate KV-cache decode emits token-for-token what
+        # workflow/generate.py's greedy generate produces
+        from znicz_tpu.export import export_lm_model
+        from znicz_tpu.workflow.generate import generate
+        from znicz_tpu.workflow.transformer import init_lm_params
+
+        prng.seed_all(27)
+        vocab, heads = 17, 4
+        params = init_lm_params(vocab, 32, 2, heads, max_seq=20)
+        prompt = np.random.default_rng(7).integers(
+            0, vocab, (3, 6)
+        ).astype(np.int32)
+        py = np.asarray(
+            generate(
+                params, jnp.asarray(prompt), n_heads=heads,
+                max_new_tokens=10,
+            )
+        )
+        model_path = str(tmp_path / "lm.znicz")
+        export_lm_model(params, model_path, n_heads=heads)
+        ip, op = str(tmp_path / "p.f32"), str(tmp_path / "o.f32")
+        prompt.astype(np.float32).tofile(ip)
+        subprocess.run(
+            [znicz_infer, model_path, ip, op, "3", "--generate", "10"],
+            check=True, capture_output=True,
+        )
+        cc = np.fromfile(op, np.float32).reshape(3, 16).astype(np.int32)
+        np.testing.assert_array_equal(py, cc)
+
+    def test_moe_generate_matches_python_greedy(self, znicz_infer, tmp_path):
+        from znicz_tpu.export import export_lm_model
+        from znicz_tpu.workflow.generate import generate
+        from znicz_tpu.workflow.transformer import init_lm_params
+
+        prng.seed_all(31)
+        vocab, heads = 17, 4
+        params = init_lm_params(
+            vocab, 32, 2, heads, max_seq=18, moe_experts=4
+        )
+        prompt = np.random.default_rng(9).integers(
+            0, vocab, (2, 5)
+        ).astype(np.int32)
+        py = np.asarray(
+            generate(
+                params, jnp.asarray(prompt), n_heads=heads,
+                max_new_tokens=8, moe_top_k=2,
+            )
+        )
+        model_path = str(tmp_path / "moe_lm.znicz")
+        export_lm_model(params, model_path, n_heads=heads, moe_top_k=2)
+        ip, op = str(tmp_path / "mp.f32"), str(tmp_path / "mo.f32")
+        prompt.astype(np.float32).tofile(ip)
+        subprocess.run(
+            [znicz_infer, model_path, ip, op, "2", "--generate", "8"],
+            check=True, capture_output=True,
+        )
+        cc = np.fromfile(op, np.float32).reshape(2, 13).astype(np.int32)
+        np.testing.assert_array_equal(py, cc)
+
+    def test_generate_capacity_guard(self, znicz_infer, tmp_path):
+        # decoding past the positional table must fail loudly
+        from znicz_tpu.export import export_lm_model
+        from znicz_tpu.workflow.transformer import init_lm_params
+
+        prng.seed_all(28)
+        params = init_lm_params(9, 16, 1, 2, max_seq=8)
+        model_path = str(tmp_path / "lm.znicz")
+        export_lm_model(params, model_path, n_heads=2)
+        prompt = np.zeros((1, 6), np.float32)
+        ip, op = str(tmp_path / "p.f32"), str(tmp_path / "o.f32")
+        prompt.tofile(ip)
+        r = subprocess.run(
+            [znicz_infer, model_path, ip, op, "1", "--generate", "5"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode != 0
+        assert "positional table" in r.stderr
+
+    def test_generate_rejects_non_lm(self, znicz_infer, tmp_path):
+        from znicz_tpu.export import export_model
+
+        prng.seed_all(3)
+        model = build(
+            [{"type": "softmax", "->": {"output_sample_shape": 4}}], (8,)
+        )
+        model_path = str(tmp_path / "mlp.znicz")
+        export_model(model, model_path)
+        prompt = np.zeros((1, 4), np.float32)
+        ip, op = str(tmp_path / "p.f32"), str(tmp_path / "o.f32")
+        prompt.tofile(ip)
+        r = subprocess.run(
+            [znicz_infer, model_path, ip, op, "1", "--generate", "3"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode != 0
+        assert "not an LM" in r.stderr

@@ -1,0 +1,198 @@
+"""KV-cache autoregressive decoding for the transformer LM.
+
+The reference deploys every model through export + a native forward engine
+(SURVEY.md 2.4 libZnicz); the flagship LM additionally needs the other half
+of its lifecycle — incremental decoding.  Re-founded TPU-first: the KV cache
+is a STATIC-shape [B, T_max, H, hd] buffer per block (XLA wants fixed
+shapes; validity is an index mask, not a dynamic length), each decode step
+is one position through the block tower (``jax.lax.dynamic_update_slice``
+into the cache, attention over the full buffer masked to ``<= pos``), and
+the whole generation loop is ONE ``lax.scan`` — a single compiled program,
+no per-token dispatch.
+
+Numerics match :func:`znicz_tpu.workflow.transformer.lm_apply` exactly
+(same projection/attention formulation, f32 accumulation), which the golden
+tests assert position-by-position.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.ops.normalization import layer_norm
+from znicz_tpu.workflow.transformer import _block_ffn
+
+
+def init_kv_cache(params, batch: int, max_seq: int, *, n_heads: int):
+    """Zeroed [B, T_max, H, hd] K/V buffers, one pair per block."""
+    caches = []
+    for block in params[1:-1]:
+        inner = block["wq"].shape[1]
+        head_dim = inner // n_heads
+        shape = (batch, max_seq, n_heads, head_dim)
+        dtype = block["wq"].dtype
+        caches.append(
+            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        )
+    return caches
+
+
+def _block_step(
+    block, x, cache, offset, *, n_heads, moe_top_k=1, moe_dispatch="dense"
+):
+    """One pre-LN block over ``x`` [B, Tq, D] at absolute positions
+    ``offset .. offset+Tq-1``, reading/writing the KV cache.  Tq is the
+    prompt length during prefill and 1 during decode — one definition for
+    both, so they cannot drift from each other (and the attention math
+    mirrors ``ops.attention.mha`` + ``dot_product_attention``: f32 score
+    accumulation, stable softmax)."""
+    b, tq, _ = x.shape
+    h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
+
+    def proj(w):
+        y = jnp.dot(h, w, preferred_element_type=jnp.float32).astype(h.dtype)
+        return y.reshape(b, tq, n_heads, -1)
+
+    q, k_new, v_new = proj(block["wq"]), proj(block["wk"]), proj(block["wv"])
+    k_cache = jax.lax.dynamic_update_slice(cache["k"], k_new, (0, offset, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(cache["v"], v_new, (0, offset, 0, 0))
+    t_max = k_cache.shape[1]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    # causal validity by ABSOLUTE index: key position <= query position
+    # (unwritten cache slots are > offset+Tq-1, so they mask out too)
+    k_idx = jnp.arange(t_max)[None, None, None, :]
+    q_idx = offset + jnp.arange(tq)[None, None, :, None]
+    s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    o = o.reshape(b, tq, -1)
+    x = x + jnp.dot(
+        o, block["wo"], preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
+    x = x + _block_ffn(
+        block, h, moe_top_k=moe_top_k, moe_dispatch=moe_dispatch
+    )
+    return x, {"k": k_cache, "v": v_cache}
+
+
+def _embed_at(embed, tokens, offset):
+    """Token + positional embedding for tokens [B, Tq] at ``offset``."""
+    tq = tokens.shape[1]
+    pos = jax.lax.dynamic_slice_in_dim(embed["pos"], offset, tq, axis=0)
+    return embed["embed"][tokens] + pos[None, :, :]
+
+
+def prefill(
+    params, tokens, caches, *, n_heads, moe_top_k=1, moe_dispatch="dense"
+):
+    """Run the prompt [B, Tp] through the tower, filling positions
+    ``0..Tp-1`` of the caches; returns (caches, last-position logits)."""
+    x = _embed_at(params[0], tokens, 0)
+    new_caches = []
+    for block, cache in zip(params[1:-1], caches):
+        x, cache = _block_step(
+            block, x, cache, 0, n_heads=n_heads,
+            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+        )
+        new_caches.append(cache)
+    return new_caches, x[:, -1] @ params[-1]["head"]
+
+
+def decode_step(
+    params, caches, token, pos, *, n_heads, moe_top_k=1, moe_dispatch="dense"
+):
+    """One incremental step: ``token`` [B] at position ``pos`` -> (caches,
+    next-position logits [B, vocab])."""
+    x = _embed_at(params[0], token[:, None], pos)
+    new_caches = []
+    for block, cache in zip(params[1:-1], caches):
+        x, cache = _block_step(
+            block, x, cache, pos, n_heads=n_heads,
+            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+        )
+        new_caches.append(cache)
+    return new_caches, x[:, 0] @ params[-1]["head"]
+
+
+def _sample(logits, key, temperature):
+    if temperature == 0.0:  # greedy
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_heads", "max_new_tokens", "temperature", "moe_top_k",
+        "moe_dispatch",
+    ),
+)
+def generate(
+    params,
+    prompt: jnp.ndarray,  # [B, Tp] int32
+    *,
+    n_heads: int,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    moe_top_k: int = 1,
+    moe_dispatch: str = "dense",
+):
+    """Autoregressive generation; returns [B, Tp + max_new_tokens] tokens
+    (prompt included).  ``temperature=0`` is greedy argmax; otherwise
+    softmax sampling at the given temperature (``rng`` required).  The
+    decode loop is one ``lax.scan`` — per-token cost is one cached
+    block-tower step, not a growing re-forward."""
+    b, tp = prompt.shape
+    t_max = tp + max_new_tokens
+    max_pos = params[0]["pos"].shape[0]
+    if t_max > max_pos:
+        raise ValueError(
+            f"prompt {tp} + max_new_tokens {max_new_tokens} exceeds the "
+            f"positional table ({max_pos}); re-init the LM with a larger "
+            "max_seq"
+        )
+    if temperature != 0.0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    if rng is None:
+        rng = jax.random.key(0)  # unused by greedy; scan wants a value
+    prompt = prompt.astype(jnp.int32)
+    caches = init_kv_cache(params, b, t_max, n_heads=n_heads)
+    caches, logits = prefill(
+        params, prompt, caches, n_heads=n_heads,
+        moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+    )
+    keys = jax.random.split(rng, max_new_tokens)
+    first = _sample(logits, keys[0], temperature)
+
+    def step(carry, key):
+        caches, token, pos = carry
+        caches, logits = decode_step(
+            params, caches, token, pos, n_heads=n_heads,
+            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+        )
+        nxt = _sample(logits, key, temperature)
+        return (caches, nxt, pos + 1), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        step, (caches, first, jnp.asarray(tp)), keys[1:]
+    )
+    out = jnp.concatenate(
+        [prompt, first[:, None], rest.T.astype(jnp.int32)], axis=1
+    )
+    return out[:, : t_max]
