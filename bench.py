@@ -396,12 +396,19 @@ def main() -> None:
     # peak: TPU v5e bf16 ~197 TFLOP/s per chip (override for other chips)
     peak = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))
 
-    # free the epoch benches' HBM (ImageNet pool, SOM state, MNIST pools)
-    # before the LM section — the mid LM config needs the headroom
+    # free EVERYTHING the earlier benches put in HBM before the LM
+    # section — the AlexNet step bench alone pins ~1.4 GB (the [1024,
+    # 227, 227, 3] f32 batch is 633 MB; params+momentum+pool the rest),
+    # and with 9 LM variants the tail rows (MoE/decode/long) OOMed in
+    # r5 trials while each passed in isolation.  fwd_flops only needs
+    # the sample shape — capture it, then drop the objects.
+    alex_sample_shape = wf.loader.sample_shape
     del iwf, im_loader, som_wf, som_loader, mstate, mwf
+    del wf, state, acc, x, y, mask, mb
     import gc
 
     gc.collect()
+    jax.clear_caches()
 
     # ---- transformer LM: the flagship beyond-parity model needs a
     # driver-visible number (VERDICT r3 #2).  Fixed ~11M-param GPT-small,
@@ -493,6 +500,12 @@ def main() -> None:
                 file=sys.stderr,
             )
             return 0.0
+        finally:
+            # compiled executables pin HBM; with 9+ LM variants in one
+            # process the accumulation OOMed the tail rows (r5 trial 1:
+            # MoE/decode/long all JaxRuntimeError, each fine in isolation)
+            jax.clear_caches()
+            gc.collect()
 
     lm_flash = lm_rate_safe(LM, LM_B, "flash", remat=False)
     lm_dense = lm_rate_safe(LM, LM_B, "dot", remat=False)
@@ -576,6 +589,9 @@ def main() -> None:
     except Exception as e:
         print(f"lm decode failed: {type(e).__name__}", file=sys.stderr)
         lm_decode = 0.0
+    finally:
+        jax.clear_caches()
+        gc.collect()
 
     # long context: flash (O(T*D) memory) + remat train the mid model at
     # 8x the headline sequence length on ONE chip — dense attention OOMs
@@ -601,7 +617,7 @@ def main() -> None:
         file=sys.stderr,
     )
     fwd_flops = _model_flops_per_image(
-        root.alexnet.get("layers"), wf.loader.sample_shape
+        root.alexnet.get("layers"), alex_sample_shape
     )
     train_flops = 3.0 * fwd_flops  # fwd + input-grad + weight-grad
     mfu = images_per_sec * train_flops / peak
