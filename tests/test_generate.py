@@ -121,6 +121,49 @@ class TestDecodeGolden:
                 n_heads=heads, max_new_tokens=8,
             )
 
+    def test_workflow_generate_method(self):
+        # the user-facing path: train a workflow, call wf.generate()
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.workflow.transformer import TransformerLMWorkflow
+
+        tokens = np.random.default_rng(3).integers(
+            0, 16, (16, 24)
+        ).astype(np.int32)
+        prng.seed_all(77)
+        ld = FullBatchLoader({"train": tokens.copy()}, minibatch_size=8)
+        wf = TransformerLMWorkflow(
+            ld, vocab=16, d_model=16, n_layers=1, n_heads=2, max_epochs=1,
+        )
+        wf.initialize(seed=77)
+        wf.run()
+        out = np.asarray(
+            wf.generate(tokens[:2, :6], max_new_tokens=8)
+        )
+        assert out.shape == (2, 14)
+        # tokens equal what the module-level greedy path produces
+        ref = np.asarray(
+            G.generate(
+                wf.state.params, jnp.asarray(tokens[:2, :6]),
+                n_heads=2, max_new_tokens=8,
+            )
+        )
+        np.testing.assert_array_equal(out, ref)
+
+    def test_workflow_generate_rejects_pipelined(self):
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.parallel import DataParallel, make_mesh
+        from znicz_tpu.workflow.transformer import TransformerLMWorkflow
+
+        tokens = np.zeros((32, 16), np.int32)
+        ld = FullBatchLoader({"train": tokens}, minibatch_size=16)
+        wf = TransformerLMWorkflow(
+            ld, vocab=4, d_model=8, n_layers=2, n_heads=2, max_epochs=1,
+            pipeline_parallel=True, parallel=DataParallel(make_mesh(4, 1, 2)),
+        )
+        wf.initialize(seed=5)
+        with pytest.raises(ValueError, match="pipelined"):
+            wf.generate(tokens[:2, :4], max_new_tokens=2)
+
     def test_temperature_without_rng_raises(self):
         params, tokens, heads, _ = _setup()
         with pytest.raises(ValueError, match="rng"):

@@ -36,6 +36,9 @@ DEFAULTS = {
     "moe_experts": 0,
     "moe_top_k": 1,
     "moe_dispatch": "dense",
+    # "bf16": q/k/v on the MXU in bf16 with f32 accumulation (1.2-1.5x
+    # on v5e; BASELINE.md round-5 section)
+    "attention_dtype": "f32",
 }
 root.transformer_lm.update(DEFAULTS)
 
@@ -79,6 +82,7 @@ def build_workflow(**overrides) -> TransformerLMWorkflow:
         "n_heads": cfg.get("n_heads", 4),
         "max_epochs": cfg.get("max_epochs", 15),
         "remat": bool(cfg.get("remat", False)),
+        "attention_dtype": cfg.get("attention_dtype", "f32"),
         "moe_experts": int(cfg.get("moe_experts", 0) or 0),
         "moe_top_k": int(cfg.get("moe_top_k", 1) or 1),
         "moe_dispatch": cfg.get("moe_dispatch", "dense"),

@@ -639,6 +639,44 @@ class TransformerLMWorkflow(Workflow):
     def _batch_target(self, mb):
         return np.zeros(len(mb.mask), np.int32)  # unused host-side dummy
 
+    def generate(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        rng=None,
+    ):
+        """KV-cache autoregressive generation from the CURRENT trained
+        params (:mod:`znicz_tpu.workflow.generate`); returns
+        [B, Tp + max_new_tokens] tokens, prompt included.  Greedy at
+        ``temperature=0``.  Non-pipelined params only (the pipelined
+        stacked-stage layout trains; export/decode from a non-pipelined
+        run, like ``export_lm_model``).  Decode attention runs f32
+        regardless of ``attention_dtype`` — that knob is a training-
+        throughput lever; decode logits golden-match the f32
+        ``lm_apply``."""
+        if self.pipeline_parallel:
+            raise ValueError(
+                "generate() wants the flat [embed, blocks..., head] param "
+                "layout; pipelined (stacked-stage) params are train-only — "
+                "decode from a non-pipelined workflow"
+            )
+        if self.state is None:
+            self.initialize()
+        from znicz_tpu.workflow.generate import generate as _generate
+
+        return _generate(
+            self.state.params,
+            jnp.asarray(prompt, jnp.int32),
+            n_heads=self.n_heads,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            rng=rng,
+            moe_top_k=self.moe_top_k,
+            moe_dispatch=self.moe_dispatch,
+        )
+
     def _sharded_flash(self):
         """Flash kernel under DataParallel: a pallas_call has no GSPMD
         partitioning rule, but batch-heads are embarrassingly parallel — a
