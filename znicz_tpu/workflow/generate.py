@@ -127,21 +127,31 @@ def decode_step(
     return new_caches, x[:, 0] @ params[-1]["head"]
 
 
-def _sample(logits, key, temperature):
-    if temperature == 0.0:  # greedy
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits / temperature, axis=-1
-    ).astype(jnp.int32)
+def _sample(logits, key, temperature, top_k, nucleus, top_p):
+    """Greedy (``greedy`` static) or temperature sampling, optionally
+    truncated to the ``top_k`` highest logits and/or the ``top_p``
+    nucleus (smallest prefix of the sorted distribution with cumulative
+    probability >= top_p; the argmax token is always kept).  Only the
+    STRUCTURAL knobs (top_k — lax.top_k wants a static k — and the
+    nucleus on/off flag) are trace-time constants; ``temperature`` and
+    ``top_p`` are traced operands, so sweeping them never recompiles
+    the decode program."""
+    logits = logits / temperature
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if nucleus:
+        sl = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sl, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p  # mass BEFORE the token; [..., 0] True
+        thr = jnp.min(
+            jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= thr, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "n_heads", "max_new_tokens", "temperature", "moe_top_k",
-        "moe_dispatch",
-    ),
-)
 def generate(
     params,
     prompt: jnp.ndarray,  # [B, Tp] int32
@@ -149,16 +159,22 @@ def generate(
     n_heads: int,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
     moe_top_k: int = 1,
     moe_dispatch: str = "dense",
 ):
     """Autoregressive generation; returns [B, Tp + max_new_tokens] tokens
     (prompt included).  ``temperature=0`` is greedy argmax; otherwise
-    softmax sampling at the given temperature (``rng`` required).  The
-    decode loop is one ``lax.scan`` — per-token cost is one cached
-    block-tower step, not a growing re-forward."""
-    b, tp = prompt.shape
+    softmax sampling at the given temperature (``rng`` required),
+    optionally truncated to the ``top_k`` highest logits and/or the
+    ``top_p`` nucleus.  The decode loop is one ``lax.scan`` — per-token
+    cost is one cached block-tower step, not a growing re-forward.
+    ``temperature``/``top_p`` are traced operands: sweeping them reuses
+    one compiled program (only greedy<->sampling, top_k, the nucleus
+    on/off flag and shapes recompile)."""
+    tp = prompt.shape[1]
     t_max = tp + max_new_tokens
     max_pos = params[0]["pos"].shape[0]
     if t_max > max_pos:
@@ -169,16 +185,57 @@ def generate(
         )
     if temperature != 0.0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"want top_k >= 0 and 0 < top_p <= 1; got {top_k}, {top_p}"
+        )
+    vocab = params[-1]["head"].shape[-1]
+    if top_k >= vocab:
+        top_k = 0  # full support — no truncation (mirrors moe's clamp)
     if rng is None:
         rng = jax.random.key(0)  # unused by greedy; scan wants a value
-    prompt = prompt.astype(jnp.int32)
+    return _generate_impl(
+        params,
+        jnp.asarray(prompt, jnp.int32),
+        jnp.float32(temperature),
+        jnp.float32(top_p),
+        rng,
+        n_heads=n_heads,
+        max_new_tokens=max_new_tokens,
+        greedy=temperature == 0.0,
+        top_k=top_k,
+        nucleus=top_p < 1.0,
+        moe_top_k=moe_top_k,
+        moe_dispatch=moe_dispatch,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_heads", "max_new_tokens", "greedy", "top_k", "nucleus",
+        "moe_top_k", "moe_dispatch",
+    ),
+)
+def _generate_impl(
+    params, prompt, temperature, top_p, rng, *, n_heads, max_new_tokens,
+    greedy, top_k, nucleus, moe_top_k, moe_dispatch,
+):
+    b, tp = prompt.shape
+    t_max = tp + max_new_tokens
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return _sample(logits, key, temperature, top_k, nucleus, top_p)
+
     caches = init_kv_cache(params, b, t_max, n_heads=n_heads)
     caches, logits = prefill(
         params, prompt, caches, n_heads=n_heads,
         moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
     )
     keys = jax.random.split(rng, max_new_tokens)
-    first = _sample(logits, keys[0], temperature)
+    first = sample(logits, keys[0])
 
     def step(carry, key):
         caches, token, pos = carry
@@ -186,7 +243,7 @@ def generate(
             params, caches, token, pos, n_heads=n_heads,
             moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
         )
-        nxt = _sample(logits, key, temperature)
+        nxt = sample(logits, key)
         return (caches, nxt, pos + 1), nxt
 
     (_, _, _), rest = jax.lax.scan(

@@ -645,6 +645,8 @@ class TransformerLMWorkflow(Workflow):
         *,
         max_new_tokens: int,
         temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
         rng=None,
     ):
         """KV-cache autoregressive generation from the CURRENT trained
@@ -672,6 +674,8 @@ class TransformerLMWorkflow(Workflow):
             n_heads=self.n_heads,
             max_new_tokens=max_new_tokens,
             temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
             rng=rng,
             moe_top_k=self.moe_top_k,
             moe_dispatch=self.moe_dispatch,
