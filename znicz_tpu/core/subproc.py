@@ -187,6 +187,22 @@ def warn_if_shared_accelerator(n_workers: int, device) -> bool:
     return False
 
 
+def _call_with_parent_platforms(packed):
+    """Worker trampoline: re-apply the PARENT's jax platform preference
+    before any backend initializes.  A spawned interpreter re-runs any
+    deployment sitecustomize, which may force an accelerator platform —
+    a worker would then try to acquire (or hang waiting for) a device the
+    parent deliberately avoided (e.g. tests pinned to CPU while a remote
+    TPU relay is down).  The per-payload ``device`` override still wins:
+    it is applied later, inside the workflow-module runner."""
+    platforms, fn, payload = packed
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    return fn(payload)
+
+
 def run_pool(fn, payloads: List[Dict[str, Any]], n_workers: int) -> list:
     """Map ``fn`` over payloads with n_workers spawned processes (order
     preserved).  n_workers<=1 still uses ONE worker process so results are
@@ -195,10 +211,19 @@ def run_pool(fn, payloads: List[Dict[str, Any]], n_workers: int) -> list:
     import multiprocessing
     from concurrent.futures import ProcessPoolExecutor
 
+    import jax
+
+    # reading the config VALUE does not initialize a backend
+    parent_platforms = jax.config.jax_platforms
     ctx = multiprocessing.get_context("spawn")
     # max_tasks_per_child=1: a FRESH interpreter per evaluation, so no
     # config-tree or PRNG state leaks between evaluations sharing a worker
     with ProcessPoolExecutor(
         max_workers=max(1, n_workers), mp_context=ctx, max_tasks_per_child=1
     ) as ex:
-        return list(ex.map(fn, payloads))
+        return list(
+            ex.map(
+                _call_with_parent_platforms,
+                [(parent_platforms, fn, p) for p in payloads],
+            )
+        )
