@@ -1,0 +1,1219 @@
+"""Per-rule unit tests for the znicz-check static analyzer.
+
+Each rule gets positive (fires) and negative (stays quiet) cases on
+small inline modules; plus pragma suppression and baseline round-trip
+semantics.  Pure-AST — no jax tracing happens here.
+"""
+
+import textwrap
+
+import pytest
+
+from znicz_tpu.analysis import engine
+from znicz_tpu.analysis.rules import RULES, get_rules
+from znicz_tpu.analysis.rules.sharding_axes import (
+    ShardingAxisRule,
+    declared_axes,
+)
+
+
+def run(src, rule_id, path="pkg/mod.py"):
+    src = textwrap.dedent(src)
+    if rule_id == "ZNC003":
+        rules = [ShardingAxisRule(axes={"data", "model", "pipe"})]
+    else:
+        rules = [RULES[rule_id]()]
+    return engine.analyze_source(src, path, rules)
+
+
+def ids(findings):
+    return [f.rule for f in findings]
+
+
+# -- ZNC001: traced branch ----------------------------------------------
+
+
+class TestTracedBranch:
+    def test_if_on_traced_arg_fires(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """,
+            "ZNC001",
+        )
+        assert ids(fs) == ["ZNC001"]
+        assert "x" in fs[0].message
+
+    def test_while_on_traced_arg_fires(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                while x > 0:
+                    x = x - 1
+                return x
+            """,
+            "ZNC001",
+        )
+        assert ids(fs) == ["ZNC001"]
+
+    def test_static_argname_is_exempt(self):
+        fs = run(
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnames=("greedy",))
+            def f(x, greedy):
+                if greedy:
+                    return x
+                return -x
+            """,
+            "ZNC001",
+        )
+        assert fs == []
+
+    def test_static_argnums_is_exempt(self):
+        fs = run(
+            """
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, n):
+                if n:
+                    return x
+                return -x
+            """,
+            "ZNC001",
+        )
+        assert fs == []
+
+    def test_is_none_and_shape_checks_are_exempt(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x, mask):
+                if mask is None:
+                    return x
+                if x.ndim == 2:
+                    return x + mask
+                return x
+            """,
+            "ZNC001",
+        )
+        assert fs == []
+
+    def test_scan_body_branching_on_carry_fires(self):
+        fs = run(
+            """
+            import jax
+
+            def outer(xs):
+                def body(carry, x):
+                    if carry > 0:
+                        carry = carry + x
+                    return carry, x
+                return jax.lax.scan(body, 0.0, xs)
+            """,
+            "ZNC001",
+        )
+        assert ids(fs) == ["ZNC001"]
+
+    def test_call_form_jit_fires(self):
+        fs = run(
+            """
+            import jax
+
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+
+            fast = jax.jit(step)
+            """,
+            "ZNC001",
+        )
+        assert ids(fs) == ["ZNC001"]
+
+    def test_partial_bound_kwargs_are_static(self):
+        """Names bound by partial() are trace-time constants —
+        branching on them is fine (pipeline.py's shard_map body does
+        exactly this with n_micro/n_stages)."""
+        fs = run(
+            """
+            from functools import partial
+            import jax
+
+            def outer(mesh, spec, x):
+                def local(xs, n_micro, n_stages):
+                    if n_micro < n_stages:
+                        raise AssertionError("bad config")
+                    return xs
+                return jax.shard_map(
+                    partial(local, n_micro=4, n_stages=2),
+                    mesh=mesh, in_specs=(spec,), out_specs=spec,
+                )(x)
+            """,
+            "ZNC001",
+        )
+        assert fs == []
+
+    def test_builtin_map_is_not_lax_map(self):
+        """Python's map() over a side-effecting helper is host code."""
+        fs = run(
+            """
+            import os
+
+            def f(x):
+                print(x)
+                return os.path.basename(x)
+
+            def collect(items):
+                return list(map(f, items))
+            """,
+            "ZNC002",
+        )
+        assert fs == []
+
+    def test_sibling_same_named_def_is_not_conflated(self):
+        """A host-side helper that merely SHARES a name with a scan
+        body in another function must not be marked traced."""
+        fs = run(
+            """
+            import jax
+
+            def trainer(xs):
+                def body(c, x):
+                    return c + x, x
+                return jax.lax.scan(body, 0.0, xs)
+
+            def reporter(rows):
+                def body(row):
+                    if row:
+                        print(row)
+                for r in rows:
+                    body(r)
+            """,
+            "ZNC002",
+        )
+        assert fs == []
+
+    def test_plain_function_is_quiet(self):
+        fs = run(
+            """
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """,
+            "ZNC001",
+        )
+        assert fs == []
+
+    def test_closure_sees_enclosing_traced_params(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                def g():
+                    if x > 0:
+                        return x
+                    return -x
+                return g()
+            """,
+            "ZNC001",
+        )
+        assert ids(fs) == ["ZNC001"]
+
+
+# -- ZNC002: host effects ------------------------------------------------
+
+
+class TestHostEffects:
+    def test_print_in_jit_fires(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+            """,
+            "ZNC002",
+        )
+        assert ids(fs) == ["ZNC002"]
+
+    def test_time_in_scan_body_fires(self):
+        fs = run(
+            """
+            import time
+            import jax
+
+            def outer(xs):
+                def body(c, x):
+                    t = time.time()
+                    return c + x, t
+                return jax.lax.scan(body, 0.0, xs)
+            """,
+            "ZNC002",
+        )
+        assert ids(fs) == ["ZNC002"]
+
+    def test_numpy_alias_in_grad_fires(self):
+        fs = run(
+            """
+            import numpy as np
+            import jax
+
+            def loss(w, x):
+                return np.sum(w * x)
+
+            g = jax.grad(loss)
+            """,
+            "ZNC002",
+        )
+        assert ids(fs) == ["ZNC002"]
+        assert "numpy.sum" in fs[0].message
+
+    def test_jnp_is_quiet(self):
+        fs = run(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return jnp.sum(x)
+            """,
+            "ZNC002",
+        )
+        assert fs == []
+
+    def test_host_code_print_is_quiet(self):
+        fs = run(
+            """
+            def f(x):
+                print(x)
+                return x
+            """,
+            "ZNC002",
+        )
+        assert fs == []
+
+    def test_device_get_and_block_until_ready_in_jit_fire(self):
+        """Host syncs inside jitted code are ZNC002's jurisdiction
+        (ZNC007 deliberately defers traced code to it)."""
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def step(xs):
+                for x in xs:
+                    jax.device_get(x)
+                    x.block_until_ready()
+                return xs
+            """,
+            "ZNC002",
+        )
+        assert ids(fs) == ["ZNC002", "ZNC002"]
+
+    def test_compat_shard_map_body_is_traced(self):
+        """The repo's own compat shim must count as a transform — the
+        shard_map bodies are exactly the per-device code these rules
+        exist to protect."""
+        fs = run(
+            """
+            import time
+            from znicz_tpu.core.compat import shard_map
+
+            def outer(mesh, spec, x):
+                def local(xs):
+                    time.time()
+                    return xs
+                return shard_map(
+                    local, mesh=mesh, in_specs=(spec,), out_specs=spec
+                )(x)
+            """,
+            "ZNC002",
+        )
+        assert ids(fs) == ["ZNC002"]
+
+    def test_partial_wrapped_shard_map_body_is_traced(self):
+        """``shard_map(partial(local, ...))`` — the repo's dominant way
+        of handing configured bodies to transforms."""
+        fs = run(
+            """
+            import time
+            from functools import partial
+            import jax
+
+            def outer(mesh, spec, x):
+                def local(xs, scale):
+                    time.time()
+                    return xs * scale
+                return jax.shard_map(
+                    partial(local, scale=2.0),
+                    mesh=mesh, in_specs=(spec,), out_specs=spec,
+                )(x)
+            """,
+            "ZNC002",
+        )
+        assert ids(fs) == ["ZNC002"]
+
+    def test_experimental_shard_map_spelling_is_traced(self):
+        fs = run(
+            """
+            import time
+            from jax.experimental.shard_map import shard_map
+
+            def outer(mesh, spec, x):
+                def local(xs):
+                    time.time()
+                    return xs
+                return shard_map(
+                    local, mesh=mesh, in_specs=(spec,), out_specs=spec
+                )(x)
+            """,
+            "ZNC002",
+        )
+        assert ids(fs) == ["ZNC002"]
+
+
+# -- ZNC003: sharding axes -----------------------------------------------
+
+
+class TestShardingAxes:
+    def test_unknown_axis_in_partition_spec_fires(self):
+        fs = run(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            spec = P("batch", None)
+            """,
+            "ZNC003",
+        )
+        assert ids(fs) == ["ZNC003"]
+        assert "batch" in fs[0].message
+
+    def test_known_axes_are_quiet(self):
+        fs = run(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            a = P("data", None)
+            b = P(("data", "model"))
+            c = P(None, "pipe")
+            """,
+            "ZNC003",
+        )
+        assert fs == []
+
+    def test_unknown_axis_in_collective_kwarg_fires(self):
+        fs = run(
+            """
+            import jax
+
+            def f(x):
+                return jax.lax.psum(x, axis_name="dp")
+            """,
+            "ZNC003",
+        )
+        assert ids(fs) == ["ZNC003"]
+
+    def test_unknown_axis_in_positional_collective_arg_fires(self):
+        """psum(x, "bacth") — the dominant positional convention."""
+        fs = run(
+            """
+            import jax
+
+            def f(x):
+                return jax.lax.psum(x, "bacth")
+            """,
+            "ZNC003",
+        )
+        assert ids(fs) == ["ZNC003"]
+
+    def test_non_jax_method_named_like_a_collective_is_quiet(self):
+        """`client.all_gather("metrics")` is someone's own method, not a
+        jax collective — its string args are not axis names."""
+        fs = run(
+            """
+            def push(client, mesh_like):
+                client.all_gather("metrics")
+                client.psum("totals")
+                mesh_like.Mesh(None, ("rows", "cols"))
+            """,
+            "ZNC003",
+        )
+        assert fs == []
+
+    def test_known_positional_collective_axis_is_quiet(self):
+        fs = run(
+            """
+            import jax
+
+            def f(x):
+                return jax.lax.psum(x, "data")
+            """,
+            "ZNC003",
+        )
+        assert fs == []
+
+    def test_mesh_axis_names_checked(self):
+        fs = run(
+            """
+            from jax.sharding import Mesh
+
+            def build(grid):
+                return Mesh(grid, ("rows", "cols"))
+            """,
+            "ZNC003",
+        )
+        assert sorted(f.message.split("'")[1] for f in fs) == [
+            "cols",
+            "rows",
+        ]
+
+    def test_declared_axes_parses_real_mesh_module(self):
+        axes = declared_axes()
+        assert {"data", "model", "pipe"} <= axes
+
+    def test_axes_resolved_against_analyzed_root(self, tmp_path):
+        """A different tree's mesh.py governs that tree's analysis —
+        e.g. a worktree branch that legitimately adds an axis."""
+        mesh_dir = tmp_path / "znicz_tpu" / "parallel"
+        mesh_dir.mkdir(parents=True)
+        (mesh_dir / "mesh.py").write_text('EXPERT_AXIS = "expert"\n')
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "from jax.sharding import PartitionSpec as P\n"
+            'a = P("expert")\n'
+            'b = P("bogus")\n'
+        )
+        fs = engine.analyze_paths(
+            [str(mod)],
+            root=str(tmp_path),
+            rules=[ShardingAxisRule()],
+        )
+        assert [f.rule for f in fs] == ["ZNC003"]
+        assert "bogus" in fs[0].message and "expert" in fs[0].message
+
+
+# -- ZNC004: prng keys ---------------------------------------------------
+
+
+class TestPrngKeys:
+    def test_hardcoded_key_fires(self):
+        fs = run(
+            """
+            import jax
+
+            k = jax.random.key(0)
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+    def test_hardcoded_prngkey_fires(self):
+        fs = run(
+            """
+            import jax
+
+            k = jax.random.PRNGKey(42)
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+    def test_core_prng_is_sanctioned(self):
+        fs = run(
+            """
+            import jax
+
+            k = jax.random.key(0)
+            """,
+            "ZNC004",
+            path="znicz_tpu/core/prng.py",
+        )
+        assert fs == []
+
+    def test_key_reuse_fires_once_per_extra_use(self):
+        fs = run(
+            """
+            import jax
+
+            def f(key, shape):
+                a = jax.random.normal(key, shape)
+                b = jax.random.uniform(key, shape)
+                return a + b
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+        assert "key" in fs[0].message
+
+    def test_split_keys_are_quiet(self):
+        fs = run(
+            """
+            import jax
+
+            def f(key, shape):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1, shape)
+                b = jax.random.uniform(k2, shape)
+                return a + b
+            """,
+            "ZNC004",
+        )
+        assert fs == []
+
+    def test_rebound_key_is_skipped(self):
+        fs = run(
+            """
+            import jax
+
+            def f(key, shape):
+                a = jax.random.normal(key, shape)
+                key = jax.random.split(key, 1)[0]
+                b = jax.random.uniform(key, shape)
+                return a + b
+            """,
+            "ZNC004",
+        )
+        assert fs == []
+
+    def test_sibling_closures_with_own_key_params_are_quiet(self):
+        """Nested scopes must not be conflated: two closures each with
+        their OWN `key` parameter is not reuse."""
+        fs = run(
+            """
+            import jax
+
+            def outer(shape):
+                def f(key):
+                    return jax.random.uniform(key, shape)
+
+                def g(key):
+                    return jax.random.normal(key, shape)
+
+                return f, g
+            """,
+            "ZNC004",
+        )
+        assert fs == []
+
+    def test_reuse_inside_nested_def_reported_exactly_once(self):
+        fs = run(
+            """
+            import jax
+
+            def outer(shape):
+                def f(key):
+                    a = jax.random.uniform(key, shape)
+                    b = jax.random.normal(key, shape)
+                    return a + b
+
+                return f
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+    def test_branch_exclusive_consumption_is_quiet(self):
+        """if/else arms are mutually exclusive — only one sampler ever
+        consumes the key."""
+        fs = run(
+            """
+            import jax
+
+            def f(key, shape, gaussian):
+                if gaussian:
+                    x = jax.random.normal(key, shape)
+                else:
+                    x = jax.random.uniform(key, shape)
+                return x
+            """,
+            "ZNC004",
+        )
+        assert fs == []
+
+    def test_keyword_spelled_key_reuse_fires(self):
+        fs = run(
+            """
+            import jax
+
+            def f(key, shape):
+                a = jax.random.normal(key=key, shape=shape)
+                b = jax.random.uniform(key=key, shape=shape)
+                return a + b
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+    def test_keyword_spelled_hardcoded_seed_fires(self):
+        fs = run(
+            """
+            import jax
+
+            k = jax.random.PRNGKey(seed=7)
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+    def test_lambda_key_reuse_fires(self):
+        fs = run(
+            """
+            import jax
+
+            sample = lambda k, s: (
+                jax.random.normal(k, s) + jax.random.uniform(k, s)
+            )
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+    def test_module_level_key_reuse_fires(self):
+        fs = run(
+            """
+            import jax
+
+            key = jax.random.split(SEED)[0]
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+    def test_locally_bound_key_reuse_fires(self):
+        """The defining assignment must not mask later reuse — the
+        classic `key = ...; use; use` silent-correlation bug."""
+        fs = run(
+            """
+            import jax
+
+            def f(seed, shape):
+                key = jax.random.fold_in(jax.random.split(seed)[0], 1)
+                a = jax.random.normal(key, shape)
+                b = jax.random.uniform(key, shape)
+                return a + b
+            """,
+            "ZNC004",
+        )
+        assert ids(fs) == ["ZNC004"]
+
+
+# -- ZNC005: donation ----------------------------------------------------
+
+
+class TestDonation:
+    def test_jit_call_without_donation_fires(self):
+        fs = run(
+            """
+            import jax
+
+            def step(state, x):
+                return state, x
+
+            fast = jax.jit(step)
+            """,
+            "ZNC005",
+        )
+        assert ids(fs) == ["ZNC005"]
+        assert "state" in fs[0].message
+
+    def test_decorated_without_donation_fires(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def step(state, x):
+                return state, x
+            """,
+            "ZNC005",
+        )
+        assert ids(fs) == ["ZNC005"]
+
+    def test_donate_argnums_is_quiet(self):
+        fs = run(
+            """
+            import jax
+
+            def step(state, x):
+                return state, x
+
+            fast = jax.jit(step, donate_argnums=(0,))
+            """,
+            "ZNC005",
+        )
+        assert fs == []
+
+    def test_no_state_param_is_quiet(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x, y):
+                return x + y
+            """,
+            "ZNC005",
+        )
+        assert fs == []
+
+
+# -- ZNC006: mutable state -----------------------------------------------
+
+
+class TestMutableState:
+    def test_mutable_default_fires(self):
+        fs = run(
+            """
+            def f(x, acc=[]):
+                acc.append(x)
+                return acc
+            """,
+            "ZNC006",
+        )
+        assert ids(fs) == ["ZNC006"]
+
+    def test_none_default_is_quiet(self):
+        fs = run(
+            """
+            def f(x, acc=None):
+                return acc
+            """,
+            "ZNC006",
+        )
+        assert fs == []
+
+    def test_empty_tuple_default_is_quiet(self):
+        fs = run(
+            """
+            def f(x, shape=()):
+                return shape
+            """,
+            "ZNC006",
+        )
+        assert fs == []
+
+    def test_module_mutable_captured_by_jit_fires(self):
+        fs = run(
+            """
+            import jax
+
+            CACHE = {}
+
+            @jax.jit
+            def f(x):
+                return x * CACHE["scale"]
+            """,
+            "ZNC006",
+        )
+        assert ids(fs) == ["ZNC006"]
+
+    def test_module_mutable_in_host_code_is_quiet(self):
+        fs = run(
+            """
+            CACHE = {}
+
+            def f(x):
+                return CACHE.get(x)
+            """,
+            "ZNC006",
+        )
+        assert fs == []
+
+    def test_local_rebinding_of_module_name_is_quiet(self):
+        """A name assigned inside the function is local THROUGHOUT it
+        (python scoping) — no module-level capture happens."""
+        fs = run(
+            """
+            import jax
+
+            CACHE = []
+
+            @jax.jit
+            def f(x):
+                CACHE = [x]
+                return CACHE[0]
+            """,
+            "ZNC006",
+        )
+        assert fs == []
+
+    def test_global_in_jit_fires(self):
+        fs = run(
+            """
+            import jax
+
+            counter = 0
+
+            @jax.jit
+            def f(x):
+                global counter
+                counter = counter + 1
+                return x
+            """,
+            "ZNC006",
+        )
+        assert "ZNC006" in ids(fs)
+
+
+# -- ZNC007: host sync in loop -------------------------------------------
+
+
+class TestHostSync:
+    def test_device_get_in_loop_fires(self):
+        fs = run(
+            """
+            import jax
+
+            def epoch(batches):
+                out = []
+                for b in batches:
+                    out.append(jax.device_get(b))
+                return out
+            """,
+            "ZNC007",
+        )
+        assert ids(fs) == ["ZNC007"]
+
+    def test_block_until_ready_in_loop_fires(self):
+        fs = run(
+            """
+            def epoch(xs):
+                for x in xs:
+                    x.block_until_ready()
+            """,
+            "ZNC007",
+        )
+        assert ids(fs) == ["ZNC007"]
+
+    def test_time_time_in_while_fires(self):
+        fs = run(
+            """
+            import time
+
+            def run():
+                while True:
+                    t = time.time()
+                    if t > 10:
+                        break
+            """,
+            "ZNC007",
+        )
+        assert ids(fs) == ["ZNC007"]
+
+    def test_outside_loop_is_quiet(self):
+        fs = run(
+            """
+            import jax
+            import time
+
+            def finish(acc):
+                t = time.time()
+                return jax.device_get(acc), t
+            """,
+            "ZNC007",
+        )
+        assert fs == []
+
+    def test_closure_defined_in_loop_is_quiet(self):
+        fs = run(
+            """
+            import jax
+
+            def make(xs):
+                fns = []
+                for x in xs:
+                    def fetch():
+                        return jax.device_get(x)
+                    fns.append(fetch)
+                return fns
+            """,
+            "ZNC007",
+        )
+        assert fs == []
+
+
+# -- ZNC008: swallowed exceptions ----------------------------------------
+
+
+class TestSwallowedExceptions:
+    def test_bare_except_fires(self):
+        fs = run(
+            """
+            def f():
+                try:
+                    return 1
+                except:
+                    return 0
+            """,
+            "ZNC008",
+        )
+        assert ids(fs) == ["ZNC008"]
+        assert "bare" in fs[0].message
+
+    def test_silent_pass_fires(self):
+        fs = run(
+            """
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    pass
+            """,
+            "ZNC008",
+        )
+        assert ids(fs) == ["ZNC008"]
+
+    def test_logging_handler_is_quiet(self):
+        fs = run(
+            """
+            import logging
+
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    logging.exception("boom")
+                    return 0
+            """,
+            "ZNC008",
+        )
+        assert fs == []
+
+    def test_return_fallback_is_quiet(self):
+        """``return <fallback>`` is a documented degraded result, not a
+        swallowed exception."""
+        fs = run(
+            """
+            def f():
+                try:
+                    return compute()
+                except OSError:
+                    return []
+            """,
+            "ZNC008",
+        )
+        assert fs == []
+
+    def test_bare_return_fires(self):
+        fs = run(
+            """
+            def f():
+                try:
+                    work()
+                except OSError:
+                    return
+            """,
+            "ZNC008",
+        )
+        assert ids(fs) == ["ZNC008"]
+
+    def test_reraise_is_quiet(self):
+        fs = run(
+            """
+            def f():
+                try:
+                    return 1
+                except Exception as e:
+                    raise RuntimeError("ctx") from e
+            """,
+            "ZNC008",
+        )
+        assert fs == []
+
+
+# -- pragmas -------------------------------------------------------------
+
+
+class TestPragmas:
+    SRC = """
+        def f():
+            try:
+                return 1
+            except Exception:{pragma}
+                pass
+        """
+
+    def test_inline_disable(self):
+        src = self.SRC.format(
+            pragma="  # znicz-check: disable=ZNC008"
+        )
+        assert run(src, "ZNC008") == []
+
+    def test_inline_disable_all(self):
+        src = self.SRC.format(pragma="  # znicz-check: disable=all")
+        assert run(src, "ZNC008") == []
+
+    def test_inline_disable_other_rule_still_fires(self):
+        src = self.SRC.format(
+            pragma="  # znicz-check: disable=ZNC001"
+        )
+        assert ids(run(src, "ZNC008")) == ["ZNC008"]
+
+    def test_file_level_disable(self):
+        src = (
+            "# znicz-check: disable-file=ZNC008\n"
+            + textwrap.dedent(self.SRC.format(pragma=""))
+        )
+        assert engine.analyze_source(
+            src, "x.py", [RULES["ZNC008"]()]
+        ) == []
+
+
+# -- baseline ------------------------------------------------------------
+
+
+class TestBaseline:
+    SRC = """
+        def f():
+            try:
+                return 1
+            except Exception:
+                pass
+        """
+
+    def findings(self):
+        return run(self.SRC, "ZNC008")
+
+    def test_round_trip(self, tmp_path):
+        fs = self.findings()
+        path = str(tmp_path / "baseline.json")
+        engine.write_baseline(fs, path)
+        baseline = engine.load_baseline(path)
+        assert engine.new_findings(fs, baseline) == []
+
+    def test_new_finding_not_suppressed(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        engine.write_baseline(self.findings(), path)
+        src = textwrap.dedent(self.SRC) + textwrap.dedent(
+            """
+            def g():
+                try:
+                    return 2
+                except ValueError:
+                    pass
+            """
+        )
+        fs = engine.analyze_source(
+            src, "pkg/mod.py", [RULES["ZNC008"]()]
+        )
+        new = engine.new_findings(fs, engine.load_baseline(path))
+        assert len(new) == 1
+        assert new[0].symbol == "g"
+
+    def test_fingerprint_survives_line_shift(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        engine.write_baseline(self.findings(), path)
+        shifted = "# a new comment line\n\n" + textwrap.dedent(self.SRC)
+        fs = engine.analyze_source(
+            shifted, "pkg/mod.py", [RULES["ZNC008"]()]
+        )
+        assert engine.new_findings(fs, engine.load_baseline(path)) == []
+
+    def test_stale_entries_reported(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        engine.write_baseline(self.findings(), path)
+        stale = engine.stale_baseline_entries(
+            [], engine.load_baseline(path)
+        )
+        assert sum(stale.values()) == 1
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert engine.load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# -- engine odds and ends ------------------------------------------------
+
+
+class TestEngine:
+    def test_rule_catalog_has_eight_active_rules(self):
+        assert len(RULES) >= 8
+        assert len({cls.severity for cls in RULES.values()}) <= 2
+
+    def test_get_rules_select_and_ignore(self):
+        assert [r.id for r in get_rules(select=["ZNC001"])] == ["ZNC001"]
+        assert "ZNC001" not in [
+            r.id for r in get_rules(ignore=["ZNC001"])
+        ]
+        with pytest.raises(ValueError):
+            get_rules(select=["ZNC999"])
+
+    def test_write_baseline_refuses_partial_rule_set(self, tmp_path):
+        """--write-baseline under --select would silently erase every
+        other rule's grandfathered entries."""
+        from znicz_tpu.analysis.__main__ import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(
+                [
+                    "--write-baseline",
+                    "--select",
+                    "ZNC003",
+                    "--baseline",
+                    str(tmp_path / "b.json"),
+                ]
+            )
+        assert exc.value.code == 2
+
+    def test_write_baseline_refuses_path_subset(self, tmp_path):
+        """A subset-path regen would erase other files' grandfathered
+        entries."""
+        from znicz_tpu.analysis.__main__ import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(
+                [
+                    "--write-baseline",
+                    "--baseline",
+                    str(tmp_path / "b.json"),
+                    "znicz_tpu/services",
+                ]
+            )
+        assert exc.value.code == 2
+
+    def test_syntax_error_reported_as_znc000(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(:\n")
+        fs = engine.analyze_paths([str(bad)], root=str(tmp_path))
+        assert [f.rule for f in fs] == ["ZNC000"]
+
+    def test_nonexistent_path_is_an_error_not_clean(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            engine.analyze_paths(
+                [str(tmp_path / "no_such_dir")], root=str(tmp_path)
+            )
+
+    def test_findings_sorted_and_pathed_relative(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        return 1\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        fs = engine.analyze_paths([str(mod)], root=str(tmp_path))
+        assert fs[0].path == "m.py"

@@ -1,0 +1,63 @@
+"""Tier-1 gate: the package must stay clean under znicz-check.
+
+Any NEW analyzer finding (relative to tools/znicz_check_baseline.json)
+fails this test, which makes JAX-hygiene regressions — tracer-leaking
+branches, host effects in jitted bodies, misspelled mesh axes, PRNG
+reuse, swallowed exceptions — a test failure instead of a silent TPU
+incident.  The workflow for a legitimate exception is an inline
+``# znicz-check: disable=RULE`` pragma with a reason, or (for
+pre-existing debt only) regenerating the baseline; see
+docs/STATIC_ANALYSIS.md.
+"""
+
+import os
+
+import znicz_tpu
+from znicz_tpu.analysis import (
+    analyze_paths,
+    load_baseline,
+    new_findings,
+)
+from znicz_tpu.analysis.engine import stale_baseline_entries
+
+PKG_DIR = os.path.dirname(os.path.abspath(znicz_tpu.__file__))
+REPO_ROOT = os.path.dirname(PKG_DIR)
+BASELINE = os.path.join(REPO_ROOT, "tools", "znicz_check_baseline.json")
+
+
+def _current_findings():
+    return analyze_paths([PKG_DIR], root=REPO_ROOT)
+
+
+def test_package_has_no_new_findings():
+    findings = _current_findings()
+    baseline = load_baseline(BASELINE)
+    new = new_findings(findings, baseline)
+    assert not new, (
+        "znicz-check found NEW finding(s) — fix them, pragma-exempt "
+        "with a reason, or (pre-existing debt only) regenerate the "
+        "baseline:\n" + "\n".join(f.format() for f in new)
+    )
+
+
+def test_baseline_is_not_stale():
+    """Burned-down debt must leave the ledger: a baseline entry that no
+    longer fires means someone fixed it — shrink the file so it can't
+    mask a future regression at the same fingerprint."""
+    findings = _current_findings()
+    baseline = load_baseline(BASELINE)
+    stale = stale_baseline_entries(findings, baseline)
+    assert not stale, (
+        "baseline entries no longer fire; regenerate with "
+        "'python -m znicz_tpu.analysis --write-baseline': "
+        + ", ".join(sorted(stale))
+    )
+
+
+def test_committed_baseline_stays_small():
+    """The baseline is a debt ledger, not a dumping ground."""
+    baseline = load_baseline(BASELINE)
+    assert sum(baseline.values()) <= 10, (
+        "the suppression baseline is growing — burn findings down or "
+        "pragma-exempt them with reasons instead of baselining"
+    )

@@ -10,13 +10,15 @@ collectives over ICI (SURVEY.md section 2.5, 5.8).
 __version__ = "0.1.0"
 
 from znicz_tpu.core.config import Config, root  # noqa: F401
-from znicz_tpu.core import prng  # noqa: F401
 from znicz_tpu.core.logger import Logger  # noqa: F401
 
 
 # Lazy top-level API (PEP 562): keeps the heavyweight subsystems (workflow,
-# parallel, services) out of a bare `import znicz_tpu`.
+# parallel, services) — and, via prng, jax itself — out of a bare
+# `import znicz_tpu`, so pure-stdlib consumers (the znicz-check CLI) run
+# on hosts with no accelerator stack at all.
 _LAZY = {
+    "prng": ("znicz_tpu.core", "prng"),
     "Workflow": ("znicz_tpu.workflow", "Workflow"),
     "StandardWorkflow": ("znicz_tpu.workflow", "StandardWorkflow"),
     "KohonenWorkflow": ("znicz_tpu.workflow", "KohonenWorkflow"),

@@ -1,0 +1,34 @@
+"""znicz-check: AST-based JAX-hygiene & sharding-consistency analyzer.
+
+The reference stack had no machine-checkable correctness tooling — unit
+wiring and device plumbing were validated only at runtime (PAPER.md flags
+this as the reconstruction risk).  This subsystem closes the gap for the
+rebuild's dominant *silent* failure modes: tracer leaks, retrace storms,
+``PartitionSpec`` axes that don't exist on the mesh, PRNG key reuse —
+none of which any test tier catches before an expensive TPU run.
+
+Usage::
+
+    python -m znicz_tpu.analysis znicz_tpu/            # report findings
+    python -m znicz_tpu.analysis --list-rules          # rule catalog
+    python -m znicz_tpu.analysis --write-baseline      # grandfather
+
+Findings are identified by stable rule IDs (``ZNC001``..).  Pre-existing
+findings live in ``tools/znicz_check_baseline.json``; the tier-1 gate
+(``tests/test_static_analysis.py``) fails only on *new* findings.
+Intentional violations are exempted inline::
+
+    t = time.time()  # znicz-check: disable=ZNC007 -- once per epoch
+
+See docs/STATIC_ANALYSIS.md for the rule catalog and baseline workflow.
+"""
+
+from znicz_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from znicz_tpu.analysis.rules import RULES, get_rules  # noqa: F401

@@ -1,0 +1,175 @@
+"""``python -m znicz_tpu.analysis`` — the znicz-check CLI.
+
+Exit codes: 0 = clean against the baseline, 1 = new findings (or
+syntax errors), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from znicz_tpu.analysis.engine import (
+    analyze_paths,
+    load_baseline,
+    new_findings,
+    stale_baseline_entries,
+    write_baseline,
+)
+from znicz_tpu.analysis.rules import RULES, get_rules
+
+# Anchor defaults to the repo root (the package's parent), NOT the cwd:
+# fingerprint paths and the baseline location must agree no matter where
+# the CLI is invoked from.
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(
+    REPO_ROOT, "tools", "znicz_check_baseline.json"
+)
+
+
+def _split_ids(value):
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="znicz-check",
+        description=(
+            "AST-based JAX-hygiene & sharding-consistency analyzer "
+            "for the znicz_tpu package"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help="files/directories to analyze (default: the znicz_tpu "
+        "package, wherever it is installed)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help=f"suppression baseline file (default: {DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather all current findings into the baseline file",
+    )
+    parser.add_argument(
+        "--select", type=_split_ids, help="only run these rule IDs"
+    )
+    parser.add_argument(
+        "--ignore", type=_split_ids, help="skip these rule IDs"
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--root",
+        default=REPO_ROOT,
+        help="directory finding paths are reported relative to "
+        "(default: the repo root; must match the baseline's)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(RULES):
+            cls = RULES[rule_id]
+            print(f"{rule_id} [{cls.severity}] {cls.title}")
+        return 0
+
+    default_target = os.path.join(REPO_ROOT, "znicz_tpu")
+    paths = args.paths or [default_target]
+    # "full run" = every rule over the whole package — the only state a
+    # baseline regen (or a stale-entry verdict) is meaningful against
+    full_run = (
+        not (args.select or args.ignore)
+        and {os.path.abspath(p) for p in paths}
+        == {os.path.abspath(default_target)}
+    )
+
+    if args.write_baseline and not full_run:
+        # a partial regen (rule or path subset) would silently erase
+        # every other rule's/file's grandfathered entries
+        parser.error(
+            "--write-baseline requires a full run (all rules, default "
+            "paths); drop --select/--ignore and positional paths"
+        )
+
+    try:
+        rules = get_rules(args.select, args.ignore)
+    except ValueError as exc:
+        parser.error(str(exc))
+
+    try:
+        findings = analyze_paths(paths, root=args.root, rules=rules)
+    except FileNotFoundError as exc:
+        parser.error(str(exc))
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(
+            f"wrote {len(findings)} finding(s) to {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = (
+        load_baseline(args.baseline) if not args.no_baseline else None
+    )
+    report = (
+        findings if baseline is None else new_findings(findings, baseline)
+    )
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                [f.__dict__ for f in report],
+                indent=2,
+            )
+        )
+    else:
+        for f in report:
+            print(f.format())
+        suppressed = len(findings) - len(report)
+        summary = f"{len(report)} new finding(s)"
+        if baseline is not None:
+            summary += f", {suppressed} baselined"
+            # on a rule/path subset most baselined entries didn't get a
+            # chance to fire, so "stale" would be meaningless (and the
+            # recommended regen destructive)
+            stale = (
+                stale_baseline_entries(findings, baseline)
+                if full_run
+                else {}
+            )
+            if stale:
+                summary += (
+                    f"; {sum(stale.values())} baseline entr(ies) no "
+                    "longer fire — regenerate with --write-baseline"
+                )
+        print(summary, file=sys.stderr)
+
+    return 1 if report else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `znicz-check | head` closing the pipe early is not a failure
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

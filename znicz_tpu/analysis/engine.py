@@ -1,0 +1,356 @@
+"""Analyzer engine: module parsing, pragmas, baselines, reporting.
+
+Pure stdlib ``ast`` — importing this module must never pull in jax (the
+CLI has to run on a build host with no accelerator stack warmed up).
+
+Suppression model (mirrors pylint's, with a stable-fingerprint baseline
+like ruff's):
+
+* inline pragma ``# znicz-check: disable=ZNC001[,ZNC002|all]`` on the
+  flagged line;
+* file-level pragma ``# znicz-check: disable-file=ZNC003`` on any line
+  of the file (conventionally the docstring's vicinity);
+* baseline file: a fingerprint multiset of grandfathered findings.
+  Fingerprints are ``rule::path::symbol::snippet`` — line numbers are
+  deliberately absent so unrelated edits above a finding don't churn
+  the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+from collections import Counter
+from io import StringIO
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+SEVERITIES = ("error", "warning")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*znicz-check:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit, addressable by a stable fingerprint."""
+
+    rule: str
+    severity: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    symbol: str  # enclosing qualname, or "<module>"
+    snippet: str  # stripped source of the flagged line
+
+    @property
+    def fingerprint(self) -> str:
+        # No line number: the baseline must survive edits elsewhere in
+        # the file.  Identical lines in one symbol are disambiguated by
+        # the baseline's multiset (count) semantics, not the key.
+        return f"{self.rule}::{self.path}::{self.symbol}::{self.snippet}"
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} [{self.severity}] {self.message}"
+        )
+
+
+class ModuleInfo:
+    """Parsed module + the shared indexes every rule needs.
+
+    ``root`` is the absolute directory the analyzed tree is rooted at
+    (when known) — rules that consult sibling files (ZNC003's mesh.py
+    axis declarations) resolve them against the TREE UNDER ANALYSIS,
+    not the installed analyzer's own checkout.
+    """
+
+    def __init__(self, source: str, path: str, root: Optional[str] = None):
+        self.path = path
+        self.root = root
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # child -> parent (rules walk up to find enclosing funcs/loops)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.line_pragmas, self.file_pragmas = _parse_pragmas(source)
+        # alias -> dotted module name ("np" -> "numpy", "jnp" -> "jax.numpy")
+        self.import_aliases: Dict[str, str] = {}
+        # name -> dotted origin for from-imports ("P" -> "jax.sharding.PartitionSpec")
+        self.from_imports: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+        from znicz_tpu.analysis.context import TracedIndex
+
+        self.traced = TracedIndex(self)
+
+    # -- node helpers ----------------------------------------------------
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted name of the enclosing defs, e.g. ``Workflow.run.body``."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(
+                cur,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """``jax.random.split`` for an Attribute/Name chain, else None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolved(self, node: ast.AST) -> Optional[str]:
+        """Dotted name with the module's own aliases expanded: with
+        ``import numpy as np``, ``np.sum`` resolves to ``numpy.sum``;
+        with ``from jax.sharding import PartitionSpec as P``, ``P``
+        resolves to ``jax.sharding.PartitionSpec``."""
+        name = self.dotted(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        if head in self.from_imports:
+            head = self.from_imports[head]
+        elif head in self.import_aliases:
+            head = self.import_aliases[head]
+        return f"{head}.{rest}" if rest else head
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(
+        self,
+        rule: str,
+        severity: str,
+        node: ast.AST,
+        message: str,
+    ) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(
+            rule=rule,
+            severity=severity,
+            path=self.path,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            symbol=self.qualname(node),
+            snippet=self.snippet(line),
+        )
+
+    def suppressed(self, finding: Finding) -> bool:
+        for scope in (
+            self.file_pragmas,
+            self.line_pragmas.get(finding.line, set()),
+        ):
+            if "all" in scope or finding.rule in scope:
+                return True
+        return False
+
+
+def _parse_pragmas(source: str):
+    """Tokenize for comments (robust against ``#`` inside strings)."""
+    line_pragmas: Dict[int, Set[str]] = {}
+    file_pragmas: Set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            kind = m.group(1)
+            rules = {
+                r.strip() for r in m.group(2).split(",") if r.strip()
+            }
+            if kind == "disable-file":
+                file_pragmas |= rules
+            else:
+                line_pragmas.setdefault(tok.start[0], set()).update(rules)
+    # znicz-check: disable=ZNC008 -- half-written file: pragmas just
+    # don't apply; the ast.parse SyntaxError is the real report
+    except tokenize.TokenError:  # znicz-check: disable=ZNC008
+        pass
+    return line_pragmas, file_pragmas
+
+
+# -- running rules -------------------------------------------------------
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence] = None,
+    root: Optional[str] = None,
+) -> List[Finding]:
+    """Analyze one module's source; pragma suppression applied."""
+    from znicz_tpu.analysis.rules import get_rules
+
+    info = ModuleInfo(source, path, root)
+    out: List[Finding] = []
+    for rule in rules if rules is not None else get_rules():
+        for finding in rule.check(info):
+            if not info.suppressed(finding):
+                out.append(finding)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if not os.path.exists(path):
+            # a typo'd target must not report "clean" on zero files
+            raise FileNotFoundError(f"no such file or directory: {path}")
+        if os.path.isfile(path):
+            yield path
+        else:
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [
+                    d
+                    for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                ]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    root: Optional[str] = None,
+    rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    """Analyze every ``.py`` under ``paths``.  Finding paths (and thus
+    fingerprints) are relative to ``root`` (default: cwd) with posix
+    separators, so baselines are machine-independent."""
+    if rules is None:
+        from znicz_tpu.analysis.rules import get_rules
+
+        rules = get_rules()  # resolve once, not per file
+    root = os.path.abspath(root or os.getcwd())
+    out: List[Finding] = []
+    for file in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(file), root).replace(
+            os.sep, "/"
+        )
+        with open(file, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            out.extend(analyze_source(source, rel, rules, root=root))
+        except SyntaxError as exc:
+            out.append(
+                Finding(
+                    rule="ZNC000",
+                    severity="error",
+                    path=rel,
+                    line=exc.lineno or 0,
+                    col=(exc.offset or 0),
+                    message=f"syntax error: {exc.msg}",
+                    symbol="<module>",
+                    snippet="",
+                )
+            )
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+# -- baseline ------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file -> fingerprint multiset (missing file = empty)."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter(data.get("findings", {}))
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    counts = Counter(f.fingerprint for f in findings)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "comment": (
+                    "znicz-check grandfathered findings; regenerate "
+                    "with python -m znicz_tpu.analysis --write-baseline"
+                ),
+                "version": 1,
+                "findings": {k: counts[k] for k in sorted(counts)},
+            },
+            f,
+            indent=2,
+        )
+        f.write("\n")
+
+
+def new_findings(
+    findings: Sequence[Finding], baseline: Counter
+) -> List[Finding]:
+    """Findings beyond the baseline's per-fingerprint allowance.  When a
+    fingerprint occurs more times than baselined, the LAST occurrences
+    (file order) are reported — the earliest are assumed grandfathered."""
+    remaining = Counter(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        if remaining[f.fingerprint] > 0:
+            remaining[f.fingerprint] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def stale_baseline_entries(
+    findings: Sequence[Finding], baseline: Counter
+) -> Counter:
+    """Baselined fingerprints that no longer occur (burned down) — the
+    CLI reports these so the baseline can be re-shrunk, keeping the debt
+    ledger honest."""
+    current = Counter(f.fingerprint for f in findings)
+    stale = Counter()
+    for fp, n in baseline.items():
+        extra = n - current.get(fp, 0)
+        if extra > 0:
+            stale[fp] = extra
+    return stale

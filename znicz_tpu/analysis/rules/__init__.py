@@ -1,0 +1,60 @@
+"""Rule registry.
+
+Every rule is a class with a stable ``id`` (``ZNCnnn`` — never reuse a
+retired number), a ``severity``, a one-line ``title`` (the catalog), and
+``check(info) -> Iterable[Finding]``.  Registration is declarative via
+the ``@register`` decorator; ``get_rules`` instantiates the active set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+RULES: Dict[str, Type] = {}
+
+
+def register(cls):
+    if cls.id in RULES:  # never let two rules share an ID silently
+        raise ValueError(f"duplicate rule id {cls.id}")
+    RULES[cls.id] = cls
+    return cls
+
+
+class Rule:
+    id = "ZNC000"
+    severity = "error"
+    title = "abstract rule"
+
+    def check(self, info):
+        raise NotImplementedError
+
+    def finding(self, info, node, message):
+        return info.finding(self.id, self.severity, node, message)
+
+
+def get_rules(
+    select: Optional[Sequence[str]] = None,
+    ignore: Optional[Sequence[str]] = None,
+) -> List[Rule]:
+    ids = sorted(RULES)
+    if select:
+        unknown = set(select) - set(ids)
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+        ids = [i for i in ids if i in set(select)]
+    if ignore:
+        ids = [i for i in ids if i not in set(ignore)]
+    return [RULES[i]() for i in ids]
+
+
+# importing the modules performs registration
+from znicz_tpu.analysis.rules import (  # noqa: E402,F401
+    donation,
+    exceptions,
+    host_effects,
+    host_sync,
+    mutable_state,
+    prng_keys,
+    sharding_axes,
+    traced_branch,
+)

@@ -1,0 +1,50 @@
+"""ZNC005: jitted train-step-shaped callables without buffer donation.
+
+A jitted function that takes and returns a train state doubles the
+state's HBM footprint unless the input buffers are donated
+(``donate_argnums``) — on a memory-bound TPU run that is the difference
+between fitting and OOM, and XLA's in-place update path is also faster.
+The heuristic: a ``jax.jit``/``pjit`` application whose wrapped function
+has a non-static parameter with a state-suggesting name (``state``,
+``train_state``, ``opt_state``) and no donation kwarg.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.analysis.rules import Rule, register
+from znicz_tpu.analysis.context import _param_names
+
+_STATE_NAMES = {
+    "state",
+    "train_state",
+    "opt_state",
+    "tstate",
+    "optimizer_state",
+}
+
+
+@register
+class DonationRule(Rule):
+    id = "ZNC005"
+    severity = "warning"
+    title = "jitted train-state function without donate_argnums"
+
+    def check(self, info):
+        for jc in info.traced.jit_calls:
+            if jc.fn is None or jc.has_donation():
+                continue
+            static = jc.static_names()
+            hits = [
+                p
+                for p in _param_names(jc.fn)
+                if p in _STATE_NAMES and p not in static
+            ]
+            if hits:
+                yield self.finding(
+                    info,
+                    jc.node,
+                    f"jit of '{jc.fn.name}' takes state-shaped "
+                    f"argument(s) {', '.join(hits)} but declares no "
+                    "donate_argnums — the old state's buffers stay live "
+                    "and double the HBM footprint",
+                )

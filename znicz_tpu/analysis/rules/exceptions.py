@@ -1,0 +1,81 @@
+"""ZNC008: bare excepts and silently swallowed exceptions.
+
+In ``parallel/`` and ``services/`` especially, a swallowed exception
+turns a real failure (a dead collective, a half-written snapshot, a
+broken status page) into silence — the reference stack's worst
+operational trait, which this rebuild explicitly hardens against.  A
+handler must do SOMETHING observable: log, re-raise, or return a
+computed fallback.  ``except Exception: pass`` is allowed only with an
+inline pragma stating why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from znicz_tpu.analysis.rules import Rule, register
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    """No call, raise, name binding, or value-returning fallback — the
+    handler observes nothing.  ``return <fallback>`` counts as handling
+    (a documented degraded result); a bare ``return`` does not."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(
+                node,
+                (
+                    ast.Raise,
+                    ast.Call,
+                    ast.Assign,
+                    ast.AugAssign,
+                    ast.AnnAssign,
+                    ast.FunctionDef,
+                    ast.AsyncFunctionDef,
+                    ast.ClassDef,
+                    ast.Import,
+                    ast.ImportFrom,
+                ),
+            ):
+                return False
+            if isinstance(node, ast.Return) and not (
+                node.value is None
+                or (
+                    isinstance(node.value, ast.Constant)
+                    and node.value.value is None
+                )
+            ):
+                return False
+    return True
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    id = "ZNC008"
+    severity = "error"
+    title = "bare except / silently swallowed exception"
+
+    def check(self, info):
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    info,
+                    node,
+                    "bare 'except:' catches SystemExit/KeyboardInterrupt "
+                    "too; catch a concrete exception type",
+                )
+            elif _handler_is_silent(node):
+                type_src = (
+                    info.dotted(node.type)
+                    or getattr(node.type, "id", None)
+                    or "…"
+                )
+                yield self.finding(
+                    info,
+                    node,
+                    f"'except {type_src}' swallows the exception "
+                    "silently; log it, re-raise, or exempt with a pragma "
+                    "stating why silence is safe",
+                )

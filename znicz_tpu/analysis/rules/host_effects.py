@@ -1,0 +1,93 @@
+"""ZNC002: host-side effects inside jitted/traced code.
+
+``print``, ``time.time()``, file I/O, ``os``/``sys`` calls and raw
+``numpy`` ops inside a traced function run once at TRACE time, not per
+step — timing reads measure dispatch, prints fire once, and ``np.``
+calls on traced arguments either crash or silently constant-fold.  The
+sanctioned equivalents are ``jax.debug.print`` / ``jax.debug.callback``
+and ``jnp`` ops.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from znicz_tpu.analysis.rules import Rule, register
+
+# builtins whose call inside traced code is a host effect
+_BUILTIN_EFFECTS = {"print", "input", "breakpoint", "open", "exec", "eval"}
+# module roots whose calls are host-side (after alias resolution)
+_MODULE_EFFECTS = {
+    "time",
+    "os",
+    "sys",
+    "io",
+    "shutil",
+    "pathlib",
+    "subprocess",
+    "socket",
+    "logging",
+    "random",  # python's random, NOT jax.random
+    "numpy",
+}
+
+
+@register
+class HostEffectRule(Rule):
+    id = "ZNC002"
+    severity = "error"
+    title = "host-side effect (print/time/io/np) inside jitted code"
+
+    def check(self, info):
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not info.traced.in_traced_code(node):
+                continue
+            # device->host syncs are method-spelled: x.block_until_ready()
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                yield self.finding(
+                    info,
+                    node,
+                    "'.block_until_ready()' inside a jitted/traced "
+                    "function is a host-side sync that cannot run "
+                    "under the tracer",
+                )
+                continue
+            resolved = info.resolved(node.func)
+            if resolved is None:
+                continue
+            root = resolved.split(".")[0]
+            # NOT device_put: inside jit it is a legitimate traceable
+            # sharding/placement hint
+            if resolved == "jax.device_get":
+                yield self.finding(
+                    info,
+                    node,
+                    "'jax.device_get' inside a jitted/traced function is "
+                    "a host-side transfer that cannot run under the "
+                    "tracer; return the value instead",
+                )
+            elif resolved in _BUILTIN_EFFECTS:
+                yield self.finding(
+                    info,
+                    node,
+                    f"'{resolved}' inside a jitted/traced function runs at "
+                    "trace time only; use jax.debug.print/callback",
+                )
+            elif root in _MODULE_EFFECTS:
+                hint = (
+                    "use jnp ops on traced values"
+                    if root == "numpy"
+                    else "hoist it out of the traced function or use "
+                    "jax.debug.callback"
+                )
+                yield self.finding(
+                    info,
+                    node,
+                    f"host-side call '{resolved}' inside a jitted/traced "
+                    f"function executes at trace time, not per step; {hint}",
+                )

@@ -1,0 +1,80 @@
+"""ZNC007: device->host syncs and wall-clock reads inside host loops.
+
+``jax.device_get`` / ``.block_until_ready()`` inside a per-minibatch
+(or per-epoch) loop serializes dispatch against the device — the exact
+round-trip cost the workflow's one-fetch-per-epoch accumulator design
+exists to avoid (workflow.py's epoch contract).  ``time.time()`` inside
+a loop is the same smell for timing: it measures dispatch, not compute,
+and belongs in the shared ``utils.profiling`` helpers (StepTimer /
+Stopwatch), which make the granularity explicit.
+
+Once-per-epoch fetches that are part of the design are exempted inline
+with ``# znicz-check: disable=ZNC007`` and a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from znicz_tpu.analysis.rules import Rule, register
+
+_SYNC_CALLS = {"jax.device_get"}
+_TIME_CALLS = {"time.time"}
+
+
+def _in_loop(info, node) -> bool:
+    """Inside a for/while body — without crossing a function boundary
+    (a closure defined in a loop does not itself run per-iteration)."""
+    cur = info.parents.get(node)
+    while cur is not None:
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return False
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        cur = info.parents.get(cur)
+    return False
+
+
+@register
+class HostSyncRule(Rule):
+    id = "ZNC007"
+    severity = "warning"
+    title = "device_get/block_until_ready/time.time inside a host loop"
+
+    def check(self, info):
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if info.traced.in_traced_code(node):
+                continue  # traced code: ZNC002's jurisdiction
+            if not _in_loop(info, node):
+                continue
+            resolved = info.resolved(node.func) or ""
+            if resolved in _SYNC_CALLS:
+                yield self.finding(
+                    info,
+                    node,
+                    f"'{resolved}' inside a loop forces a device->host "
+                    "round trip per iteration; accumulate on device and "
+                    "fetch once (or exempt a per-epoch fetch explicitly)",
+                )
+            elif resolved in _TIME_CALLS:
+                yield self.finding(
+                    info,
+                    node,
+                    "'time.time()' inside a loop: use the shared "
+                    "utils.profiling StepTimer/Stopwatch so timing "
+                    "granularity is explicit and consistent",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                yield self.finding(
+                    info,
+                    node,
+                    "'.block_until_ready()' inside a loop serializes "
+                    "dispatch against the device every iteration",
+                )

@@ -1,0 +1,122 @@
+"""ZNC006: mutable defaults and mutable module state near jitted code.
+
+Mutable default arguments are the classic Python shared-state bug; in a
+jax codebase they are worse, because a default that leaks into a jitted
+call participates in tracing and caching.  Module-level mutable
+literals captured by a traced closure are baked in as compile-time
+constants at FIRST trace — later mutation silently does nothing to the
+compiled program.  ``global`` inside a traced function can only be a
+host-side effect at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from znicz_tpu.analysis.context import _param_names
+from znicz_tpu.analysis.rules import Rule, register
+
+_MUTABLE_LITERALS = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+)
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray", "defaultdict"}
+
+
+def _is_mutable_expr(node: ast.AST) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _MUTABLE_CALLS
+    return False
+
+
+def _scope_local_names(fn) -> set:
+    """Parameters plus every name the function itself binds — python
+    scoping makes such a name local THROUGHOUT the function, so a load
+    of it can never capture the module-level variable."""
+    names = set(_param_names(fn))
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue  # nested scopes bind their own names
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+@register
+class MutableStateRule(Rule):
+    id = "ZNC006"
+    severity = "warning"
+    title = "mutable default arg / mutable module state in jitted closure"
+
+    def check(self, info):
+        # (a) mutable default arguments, anywhere
+        for fn in ast.walk(info.tree):
+            if not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            defaults = list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                if _is_mutable_expr(d):
+                    name = getattr(fn, "name", "<lambda>")
+                    yield self.finding(
+                        info,
+                        d,
+                        f"mutable default argument in '{name}' is shared "
+                        "across calls; default to None and create inside",
+                    )
+        # module-level names bound to mutable literals
+        module_mutables: Dict[str, ast.AST] = {}
+        for stmt in info.tree.body:
+            if isinstance(stmt, ast.Assign) and _is_mutable_expr(
+                stmt.value
+            ):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_mutables[t.id] = stmt
+        # (b) traced closures capturing module-level mutables; (c) global
+        for node in ast.walk(info.tree):
+            if not info.traced.in_traced_code(node):
+                continue
+            if isinstance(node, ast.Global):
+                yield self.finding(
+                    info,
+                    node,
+                    "'global' inside a jitted/traced function mutates "
+                    "host state at trace time only",
+                )
+            elif (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in module_mutables
+            ):
+                fn = info.enclosing_function(node)
+                local_names = set()
+                while fn is not None:
+                    local_names |= _scope_local_names(fn)
+                    fn = info.enclosing_function(fn)
+                if node.id in local_names:
+                    continue  # shadowed by a parameter or local binding
+                yield self.finding(
+                    info,
+                    node,
+                    f"module-level mutable '{node.id}' captured by a "
+                    "jitted/traced function is frozen at first trace; "
+                    "pass it as an argument or make it immutable",
+                )

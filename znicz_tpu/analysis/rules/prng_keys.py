@@ -1,0 +1,197 @@
+"""ZNC004: PRNG key hygiene — hard-coded seeds and key reuse.
+
+The sanctioned key source is ``znicz_tpu.core.prng`` (named generator
+registry): it makes every stream reproducible, decorrelated, and
+snapshot-resumable.  ``jax.random.key(0)`` scattered through the code
+silently correlates streams and breaks the exact-resume contract.
+
+Reuse: passing the SAME key object to two consuming ``jax.random``
+samplers yields identical draws — the classic silent-correlation bug.
+Detection is conservative: a name is only flagged when it is consumed
+by two or more sampler calls within one function and never reassigned
+between (names that are ever re-bound in the function are skipped).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from znicz_tpu.analysis.rules import Rule, register
+
+# jax.random callables that DERIVE rather than consume (not reuse sinks)
+_DERIVERS = {
+    "split",
+    "fold_in",
+    "key",
+    "PRNGKey",
+    "key_data",
+    "wrap_key_data",
+    "key_impl",
+    "clone",
+}
+_KEY_MAKERS = {"jax.random.key", "jax.random.PRNGKey"}
+_SANCTIONED_PATH = "core/prng.py"
+
+
+def _jax_random_call(info, node: ast.Call):
+    resolved = info.resolved(node.func) or ""
+    if resolved.startswith("jax.random."):
+        return resolved[len("jax.random."):]
+    return None
+
+
+def _walk_own_scope(fn):
+    """Descendants of ``fn`` WITHOUT entering nested function scopes —
+    ``ast.walk`` would yield their bodies too, conflating the key
+    namespaces of sibling closures."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue  # its own pass covers it
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _branch_arms(info, node):
+    """{branching-node-id: arm} for every If/Try on ``node``'s ancestor
+    chain, where arm identifies which mutually exclusive list (if-body
+    vs orelse, try-body vs handlers) contains the chain."""
+    arms = {}
+    cur = node
+    parent = info.parents.get(cur)
+    while parent is not None:
+        if isinstance(parent, ast.If):
+            if any(cur is stmt for stmt in parent.body):
+                arms[id(parent)] = "body"
+            elif any(cur is stmt for stmt in parent.orelse):
+                arms[id(parent)] = "orelse"
+        elif isinstance(parent, ast.Try):
+            if any(cur is stmt for stmt in parent.body):
+                arms[id(parent)] = "body"
+            elif any(cur is h for h in parent.handlers):
+                arms[id(parent)] = "handlers"
+        cur, parent = parent, info.parents.get(parent)
+    return arms
+
+
+def _mutually_exclusive(info, a, b) -> bool:
+    """True when ``a`` and ``b`` sit in disjoint arms of a shared
+    If/Try — at most one of them executes, so it is not key reuse."""
+    arms_a = _branch_arms(info, a)
+    arms_b = _branch_arms(info, b)
+    return any(
+        key in arms_b and arms_b[key] != arm
+        for key, arm in arms_a.items()
+    )
+
+
+@register
+class PrngKeyRule(Rule):
+    id = "ZNC004"
+    severity = "warning"
+    title = "hard-coded jax.random key / key reuse outside core/prng"
+
+    def check(self, info):
+        sanctioned = info.path.replace("\\", "/").endswith(
+            _SANCTIONED_PATH
+        )
+        # (a) hard-coded key construction
+        if not sanctioned:
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = info.resolved(node.func) or ""
+                if resolved in _KEY_MAKERS and any(
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, int)
+                    for a in (
+                        list(node.args)
+                        + [kw.value for kw in node.keywords if kw.arg]
+                    )
+                ):
+                    yield self.finding(
+                        info,
+                        node,
+                        f"hard-coded '{resolved.rsplit('.', 1)[-1]}' seed "
+                        "outside core/prng.py; derive keys from the named "
+                        "generator registry (core.prng.get(name).key()) so "
+                        "streams stay decorrelated and resumable",
+                    )
+        # (b) same key consumed by >= 2 samplers with no re-binding of
+        # the name between the consumptions (line-position approximation:
+        # an assignment strictly between two uses resets the chain).
+        # Every name scope gets a pass: module level, functions, lambdas.
+        scopes = [info.tree] + [
+            n
+            for n in ast.walk(info.tree)
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+        ]
+        for fn in scopes:
+            assigned: Dict[str, List[int]] = {}
+            consumed: Dict[str, List[ast.Call]] = {}
+            for node in _walk_own_scope(fn):
+                if isinstance(
+                    node, (ast.Assign, ast.AugAssign, ast.AnnAssign)
+                ):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name):
+                                assigned.setdefault(sub.id, []).append(
+                                    node.lineno
+                                )
+                elif isinstance(node, (ast.For, ast.comprehension)):
+                    for sub in ast.walk(node.target):
+                        if isinstance(sub, ast.Name):
+                            assigned.setdefault(sub.id, []).append(
+                                getattr(node, "lineno", 0)
+                            )
+                if not isinstance(node, ast.Call):
+                    continue
+                sampler = _jax_random_call(info, node)
+                if sampler is None or sampler in _DERIVERS:
+                    continue
+                key_arg = (
+                    node.args[0]
+                    if node.args
+                    else next(
+                        (
+                            kw.value
+                            for kw in node.keywords
+                            if kw.arg == "key"
+                        ),
+                        None,
+                    )
+                )
+                if isinstance(key_arg, ast.Name):
+                    consumed.setdefault(key_arg.id, []).append(node)
+            for name, sites in consumed.items():
+                if len(sites) < 2:
+                    continue
+                sites.sort(key=lambda s: s.lineno)
+                lines = assigned.get(name, [])
+                for prev, site in zip(sites, sites[1:]):
+                    if any(
+                        prev.lineno < a <= site.lineno for a in lines
+                    ):
+                        continue  # re-bound between the two consumptions
+                    if _mutually_exclusive(info, prev, site):
+                        continue  # disjoint if/try arms: only one runs
+                    yield self.finding(
+                        info,
+                        site,
+                        f"PRNG key '{name}' is consumed by multiple "
+                        "jax.random samplers in this function — identical "
+                        "draws; split the key (jax.random.split) per "
+                        "consumer",
+                    )

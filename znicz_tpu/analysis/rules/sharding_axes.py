@@ -1,0 +1,171 @@
+"""ZNC003: PartitionSpec / collective axis names the mesh doesn't declare.
+
+The canonical mesh axes are declared once, in
+``znicz_tpu/parallel/mesh.py`` (``DATA_AXIS = "data"`` ...).  A
+``PartitionSpec("bacth")`` or ``psum(..., axis_name="dp")`` with an axis
+the mesh never declares fails only at run time on a real mesh — or, for
+collectives inside ``shard_map``, with an error message far from the
+typo.  This rule cross-checks every string-literal axis name against
+the declared constants.
+
+The declared set is parsed from mesh.py's AST (no jax import); modules
+are expected to reference the ``*_AXIS`` constants rather than repeat
+the strings, so literal axis names in *other* modules are already a
+smell — but a literal that matches a declared axis is accepted.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional, Set
+
+from znicz_tpu.analysis.rules import Rule, register
+
+# calls whose string args / axis kwargs name mesh axes
+_SPEC_CALLS = {"jax.sharding.PartitionSpec", "PartitionSpec"}
+_AXIS_KWARGS = {"axis_name", "axis_names", "axis"}
+_COLLECTIVES = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "all_gather",
+    "all_to_all",
+    "axis_index",
+    "psum_scatter",
+    "pbroadcast",
+}
+# an attribute chain only counts as a jax collective / Mesh when it is
+# rooted in a jax module — `client.all_gather("metrics")` is not one
+_COLLECTIVE_HOMES = {"jax", "lax", "jax.lax"}
+_MESH_HOMES = {"jax", "jax.sharding"}
+
+_MESH_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    "parallel",
+    "mesh.py",
+)
+
+
+def declared_axes(mesh_file: str = _MESH_FILE) -> Set[str]:
+    """``*_AXIS = "name"`` string constants from mesh.py, by AST."""
+    axes: Set[str] = set()
+    try:
+        with open(mesh_file, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        # missing/broken mesh.py: the rule degrades to a no-op by
+        # design (check() returns early on an empty axis set)
+        return axes
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            if not isinstance(node.value.value, str):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id.endswith(
+                    "_AXIS"
+                ):
+                    axes.add(node.value.value)
+    return axes
+
+
+def _literal_axis_names(node: ast.AST):
+    """String literals in a spec arg: "data", ("data", "model"), [..]."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, str
+            ):
+                yield elt.value, elt
+
+
+@register
+class ShardingAxisRule(Rule):
+    id = "ZNC003"
+    severity = "error"
+    title = "PartitionSpec/collective axis name not declared by the mesh"
+
+    def __init__(self, axes: Optional[Set[str]] = None):
+        self._fixed_axes = axes
+        self._axes_by_root = {}
+
+    def _axes_for(self, info) -> Set[str]:
+        """Axis declarations of the TREE BEING ANALYZED: prefer
+        ``<root>/znicz_tpu/parallel/mesh.py`` (a branch/worktree may
+        legitimately declare more axes than this installed checkout),
+        falling back to the analyzer's own sibling mesh.py."""
+        if self._fixed_axes is not None:
+            return self._fixed_axes
+        key = getattr(info, "root", None) or ""
+        if key not in self._axes_by_root:
+            mesh_file = _MESH_FILE
+            if key:
+                candidate = os.path.join(
+                    key, "znicz_tpu", "parallel", "mesh.py"
+                )
+                if os.path.exists(candidate):
+                    mesh_file = candidate
+            self._axes_by_root[key] = declared_axes(mesh_file)
+        return self._axes_by_root[key]
+
+    def _flag(self, info, node, axis, where, axes):
+        return self.finding(
+            info,
+            node,
+            f"axis name '{axis}' in {where} is not declared by "
+            f"parallel/mesh.py (known: {', '.join(sorted(axes))}); "
+            "reference the *_AXIS constants instead of string literals",
+        )
+
+    def check(self, info):
+        axes = self._axes_for(info)
+        if not axes:
+            return  # mesh.py missing: nothing to check against
+        if info.path.replace(os.sep, "/").endswith("parallel/mesh.py"):
+            return  # the declaration site itself
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = info.resolved(node.func) or ""
+            home, _, base = resolved.rpartition(".")
+            if base in _COLLECTIVES and home not in _COLLECTIVE_HOMES:
+                base = ""  # someone's own method, not a jax collective
+            if base == "Mesh" and home not in _MESH_HOMES:
+                base = ""
+            if resolved in _SPEC_CALLS or base == "PartitionSpec":
+                for arg in node.args:
+                    for axis, site in _literal_axis_names(arg):
+                        if axis not in axes:
+                            yield self._flag(
+                                info, site, axis, "PartitionSpec", axes
+                            )
+            if base in _COLLECTIVES or base == "Mesh":
+                for kw in node.keywords:
+                    if kw.arg in _AXIS_KWARGS:
+                        for axis, site in _literal_axis_names(kw.value):
+                            if axis not in axes:
+                                yield self._flag(
+                                    info, site, axis, f"{base}()", axes
+                                )
+                if base in _COLLECTIVES:
+                    # positional axis_name (psum(x, "data") — the
+                    # dominant calling convention): collectives take no
+                    # other string arguments, so any literal is an axis
+                    for arg in node.args:
+                        for axis, site in _literal_axis_names(arg):
+                            if axis not in axes:
+                                yield self._flag(
+                                    info, site, axis, f"{base}()", axes
+                                )
+                if base == "Mesh" and len(node.args) >= 2:
+                    for axis, site in _literal_axis_names(node.args[1]):
+                        if axis not in axes:
+                            yield self._flag(
+                                info, site, axis, "Mesh axis_names", axes
+                            )

@@ -1,0 +1,86 @@
+"""ZNC001: Python control flow branching on traced values.
+
+``if``/``while`` (and conditional expressions) on a traced array raise
+``ConcretizationTypeError`` at trace time in the best case; in the worst
+case (shape-dependent code that happens to concretize) they silently
+bake one branch into the compiled program.  Inside jitted code the
+data-dependent form is ``jnp.where`` / ``lax.cond`` / ``lax.select``.
+
+Approximation: a condition is suspect when it *consumes the value* of a
+non-static parameter of the enclosing traced function chain.  Reading
+trace-time-concrete properties is fine and excluded: ``x is None``,
+``isinstance(x, ...)``, ``hasattr``, ``len(x)``, ``callable``, and the
+``.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` attributes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from znicz_tpu.analysis.rules import Rule, register
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+_STATIC_CALLS = {"isinstance", "hasattr", "len", "callable", "getattr", "type"}
+
+
+def _value_usages(test: ast.AST, traced: Set[str]) -> List[str]:
+    """Traced names whose *value* the condition consumes."""
+    skip: Set[ast.AST] = set()
+
+    for node in ast.walk(test):
+        # `x is None` / `x is not None`: a concrete Python identity check
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            if all(
+                isinstance(c, ast.Constant)
+                for c in node.comparators
+            ):
+                skip.update(ast.walk(node))
+        # len(x), isinstance(x, T), hasattr(x, a): trace-time concrete
+        elif isinstance(node, ast.Call):
+            name = node.func.id if isinstance(node.func, ast.Name) else None
+            if name in _STATIC_CALLS:
+                skip.update(ast.walk(node))
+        # x.ndim == 4, x.shape[0] ...: static under tracing
+        elif isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            skip.update(ast.walk(node))
+
+    hits: List[str] = []
+    for node in ast.walk(test):
+        if node in skip:
+            continue
+        if isinstance(node, ast.Name) and node.id in traced:
+            hits.append(node.id)
+    return sorted(set(hits))
+
+
+@register
+class TracedBranchRule(Rule):
+    id = "ZNC001"
+    severity = "error"
+    title = "Python if/while on a traced value inside jitted code"
+
+    def check(self, info):
+        for node in ast.walk(info.tree):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            if not info.traced.in_traced_code(node):
+                continue
+            traced = info.traced.traced_param_names(node)
+            names = _value_usages(node.test, traced)
+            if names:
+                kind = {
+                    ast.If: "if",
+                    ast.While: "while",
+                    ast.IfExp: "conditional expression",
+                }[type(node)]
+                yield self.finding(
+                    info,
+                    node,
+                    f"{kind} branches on traced value(s) "
+                    f"{', '.join(names)} inside a jitted/traced function; "
+                    "use jnp.where or lax.cond, or declare the argument "
+                    "static",
+                )

@@ -1,3 +1,15 @@
 from znicz_tpu.core.config import Config, root  # noqa: F401
-from znicz_tpu.core import prng  # noqa: F401
 from znicz_tpu.core.logger import Logger  # noqa: F401
+
+
+def __getattr__(name):
+    # PEP 562: prng pulls in jax — load it on first use so pure-stdlib
+    # consumers (the znicz-check CLI) can import the package on hosts
+    # with no accelerator stack at all
+    if name == "prng":
+        import importlib
+
+        module = importlib.import_module("znicz_tpu.core.prng")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'znicz_tpu.core' has no attribute {name!r}")

@@ -1,0 +1,56 @@
+"""Compatibility shims over moving jax API surfaces.
+
+The package targets the modern spelling of each API; this module maps
+it onto older installs so one codebase runs everywhere the container
+fleet does.  Keep each shim tiny, forward-first (new API when present),
+and delete it when the fleet's floor moves past the old spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with fallback to the pre-0.6 experimental home.
+
+    jax >= 0.6 exposes ``jax.shard_map(..., check_vma=)``; older
+    releases ship it as ``jax.experimental.shard_map.shard_map`` with
+    the equivalent switch named ``check_rep`` — and the promotion and
+    the kwarg rename did NOT land in the same release, so the kwarg is
+    probed from the signature rather than inferred from the home.
+    Call sites use the modern keyword; the shim translates.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    kwarg = "check_vma"
+    try:
+        import inspect
+
+        if "check_vma" not in inspect.signature(sm).parameters:
+            kwarg = "check_rep"
+    # unintrospectable callable: keep the modern spelling
+    except (TypeError, ValueError):  # znicz-check: disable=ZNC008
+        pass
+    return sm(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{kwarg: check_vma},
+    )
+
+
+def pcast(x, axis_name, *, to: str = "varying"):
+    """``jax.lax.pcast`` with an identity fallback.
+
+    The varying-manual-axes (vma) annotation only exists from jax 0.6;
+    earlier shard_map has no vma tracking, so there is nothing to cast
+    — the value itself is unchanged either way.
+    """
+    import jax.lax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x

@@ -170,8 +170,9 @@ class Launcher(Logger):
                     if "parallel" in bound.arguments:
                         self.workflow = workflow_cls(*wf_args, **wf_kwargs)
                         return self.workflow
-                except TypeError:
-                    pass  # bind failure: let the real constructor report it
+                # bind failure: let the real constructor report it
+                except TypeError:  # znicz-check: disable=ZNC008
+                    pass
             except (TypeError, ValueError):  # C callables, odd metaclasses
                 accepts = True
             if accepts:

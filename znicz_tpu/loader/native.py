@@ -16,6 +16,7 @@ numpy — it exists so callers have one code path.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
@@ -59,7 +60,18 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                     capture_output=True,
                 )
             lib = ctypes.CDLL(so_path)
-        except (OSError, subprocess.SubprocessError):
+        except (OSError, subprocess.SubprocessError) as exc:
+            # falling back to the numpy path is fine for correctness but
+            # is a silent multi-x batch-assembly slowdown — say why
+            detail = getattr(exc, "stderr", None)
+            if detail:
+                detail = detail.decode(errors="replace").strip()[:200]
+            logging.getLogger(__name__).warning(
+                "native batch assembler unavailable (%s); using the "
+                "numpy fallback%s",
+                exc,
+                f" — compiler said: {detail}" if detail else "",
+            )
             return None
         f64p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")

@@ -29,6 +29,7 @@ from typing import Dict
 
 import numpy as np
 
+from znicz_tpu.core.compat import shard_map
 from znicz_tpu.loader.base import TRAIN, pool_offsets
 
 
@@ -195,7 +196,7 @@ class PoolShardedMixin:
         spec = P(DATA_AXIS)
 
         def pre(payload, ctx):
-            return jax.shard_map(
+            return shard_map(
                 per_shard_pre,
                 mesh=mesh,
                 in_specs=(spec, spec),

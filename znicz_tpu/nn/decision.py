@@ -38,8 +38,10 @@ class EpochMetrics:
                 continue
             try:
                 v = float(v)
-            except (TypeError, ValueError):
-                continue  # non-scalar extras (confusion matrix) not reduced
+            # type filter: non-scalar extras (confusion matrix) are
+            # intentionally not reduced here
+            except (TypeError, ValueError):  # znicz-check: disable=ZNC008
+                continue
             if k.startswith("max_"):  # peak-style metrics keep the max
                 self.extras[k] = max(self.extras.get(k, float("-inf")), v)
             else:  # everything else is a sample-weighted epoch mean

@@ -28,6 +28,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh
 
+from znicz_tpu.core.compat import shard_map
+
 BATCH_TILE = 256
 
 
@@ -158,7 +160,7 @@ def train_step(
         den = jax.lax.psum(den, data_axis)
         return _apply_update(w, num, den, lr)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(), P(), P()),

@@ -35,6 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh
 
+from znicz_tpu.core.compat import shard_map
+
 # single-block kernel: everything resident in VMEM.  RBM-sized problems
 # (MNIST: 784x1024 weights, batches <= 1024) fit with room to spare.
 # Above this budget cd_step raises up front (no silent Mosaic failure);
@@ -144,7 +146,11 @@ def _statistics(params, v0, mask, seed, *, cd_k):
         # no Mosaic RNG off-TPU: precompute the chain's uniforms from the
         # seed (deterministic given seed, like the hardware path)
         key = jax.random.fold_in(
-            jax.random.key(0), jnp.asarray(seed, jnp.int32)
+            # deliberately seed-deterministic, mirroring the hardware
+            # RNG path (same seed -> same chain on every backend); NOT a
+            # training stream, so the prng registry is the wrong source
+            jax.random.key(0),  # znicz-check: disable=ZNC004
+            jnp.asarray(seed, jnp.int32),
         )
         kh, kv = jax.random.split(key)
         uh = jax.random.uniform(kh, (1 + cd_k, b, h), jnp.float32)
@@ -251,7 +257,7 @@ def cd_step(
         )
         return _apply_update(params, dw, dvb, dhb, stats, lr)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(), P()),

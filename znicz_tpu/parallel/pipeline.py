@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from znicz_tpu.core.compat import pcast, shard_map
 from znicz_tpu.parallel.mesh import PIPE_AXIS  # noqa: F401  (canonical axis)
 
 
@@ -88,7 +89,7 @@ def _local_pipeline(
     # fresh constants are unvarying: pcast buf to varying over EVERY manual
     # axis (pipe, and data when composing with DP) before it mixes with
     # device-dependent values; zeros_like(x) inherits varying from x
-    buf0 = jax.lax.pcast(
+    buf0 = pcast(
         jnp.zeros(x.shape[1:], x.dtype),
         vary_axes or axis_name,
         to="varying",
@@ -217,7 +218,7 @@ def pipeline_apply(
     # row dim additionally shards over data (independent pipeline per
     # data replica)
     store_spec = P(axis, data_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             _local_pipeline,
             apply_one=apply_one,

@@ -13,12 +13,14 @@ import json
 import os
 import time
 
+from znicz_tpu.utils.profiling import Stopwatch
+
 
 class MarkdownReporter:
     def __init__(self, directory: str, *, filename: str = "report.md"):
         self.directory = directory
         self.filename = filename
-        self._t0 = time.time()
+        self._clock = Stopwatch()
         os.makedirs(directory, exist_ok=True)
 
     def on_epoch(self, workflow, verdict) -> None:
@@ -29,7 +31,7 @@ class MarkdownReporter:
             f"# Run report: {workflow.name}",
             "",
             f"- finished: {time.strftime('%Y-%m-%d %H:%M:%S')}",
-            f"- wall time: {time.time() - self._t0:.1f}s",
+            f"- wall time: {self._clock.elapsed():.1f}s",
             f"- epochs: {dec.epoch}",
             f"- best value: {dec.best_value} (epoch {dec.best_epoch})",
             f"- loss function: {workflow.loss_function}",

@@ -18,15 +18,19 @@ from __future__ import annotations
 
 import html
 import json
+import logging
 import os
-import time
+
+from znicz_tpu.utils.profiling import Stopwatch
+
+logger = logging.getLogger(__name__)
 
 
 class StatusWriter:
     def __init__(self, directory: str, *, refresh_seconds: int = 5):
         self.directory = directory
         self.refresh_seconds = refresh_seconds
-        self._t0 = time.time()
+        self._clock = Stopwatch()
         os.makedirs(directory, exist_ok=True)
 
     def on_epoch(self, workflow, verdict) -> None:
@@ -39,7 +43,7 @@ class StatusWriter:
             "best_epoch": dec.best_epoch,
             "improved": bool(verdict["improved"]),
             "stopping": bool(verdict["stop"]),
-            "elapsed_seconds": round(time.time() - self._t0, 1),
+            "elapsed_seconds": round(self._clock.elapsed(), 1),
             "devices": self._devices(),
             "summary": verdict["summary"],
             "history_len": len(dec.history),
@@ -61,7 +65,10 @@ class StatusWriter:
             import jax
 
             return [str(d) for d in jax.devices()]
-        except Exception:  # status must never break training
+        except Exception:
+            # status must never break training, but the degraded page
+            # should be diagnosable
+            logger.debug("device listing failed", exc_info=True)
             return []
 
     def _plot_images(self) -> list:
@@ -75,8 +82,14 @@ class StatusWriter:
                         os.path.getmtime(os.path.join(self.directory, name))
                     )
                     out.append((name, mtime))
-        except OSError:  # status must never break training
-            pass
+        except OSError:
+            # a plotter writing concurrently can race the listing;
+            # status must never break training, but leave a trace
+            logger.debug(
+                "plot image listing failed in %s",
+                self.directory,
+                exc_info=True,
+            )
         return out
 
     def _write_html(self, status) -> None:

@@ -31,6 +31,26 @@ def trace(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
+class Stopwatch:
+    """Wall-clock elapsed-seconds tracker.
+
+    The one shared implementation of the run-lifetime bookkeeping that
+    the status page, the run report and the training loop all need —
+    monotonic (immune to NTP clock steps mid-run), resettable, and
+    loggable without each consumer keeping its own ``t0`` arithmetic.
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        """Seconds since construction (or the last :meth:`reset`)."""
+        return time.monotonic() - self._t0
+
+
 class StepTimer:
     """Accumulate per-phase wall-clock times (the reference's per-unit timing
     ledger, SURVEY.md 5.1) without forcing device syncs: timings are host

@@ -193,7 +193,12 @@ def generate(
     if top_k >= vocab:
         top_k = 0  # full support — no truncation (mirrors moe's clamp)
     if rng is None:
-        rng = jax.random.key(0)  # unused by greedy; scan wants a value
+        # only reachable in greedy mode (temperature != 0 raised above),
+        # where the key is NEVER consumed — the scan just wants a
+        # key-typed operand.  A registry draw here would advance (and
+        # snapshot) a stream nothing reads; a fixed dummy is the honest
+        # spelling, same pattern as ops/pallas/rbm.py.
+        rng = jax.random.key(0)  # znicz-check: disable=ZNC004
     return _generate_impl(
         params,
         jnp.asarray(prompt, jnp.int32),

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu.core import prng
+from znicz_tpu.core.compat import shard_map
 from znicz_tpu.loader.base import Loader
 from znicz_tpu.nn import optimizer
 from znicz_tpu.nn.decision import Decision
@@ -698,7 +699,7 @@ class TransformerLMWorkflow(Workflow):
         spec = P(DATA_AXIS, None, MODEL_AXIS if shard_heads else None, None)
 
         def fn(q, k, v, *, causal=False, scale=None):
-            return jax.shard_map(
+            return shard_map(
                 partial(flash_attention, causal=causal, scale=scale),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
