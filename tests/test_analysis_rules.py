@@ -780,6 +780,97 @@ class TestDonation:
         )
         assert fs == []
 
+    def test_trainstate_annotation_fires_despite_renamed_param(self):
+        # a renamed state arg with a TrainState annotation still gets
+        # the donation check (the name heuristic alone would miss it)
+        fs = run(
+            """
+            import jax
+            from znicz_tpu.nn.train_state import TrainState
+
+            @jax.jit
+            def step(ts: TrainState, x):
+                return ts, x
+            """,
+            "ZNC005",
+        )
+        assert ids(fs) == ["ZNC005"]
+        assert "ts" in fs[0].message
+
+    def test_dotted_and_optional_annotations_fire(self):
+        fs = run(
+            """
+            import jax
+            from typing import Optional
+            from znicz_tpu.nn import train_state
+
+            @jax.jit
+            def a(s0: train_state.TrainState, x):
+                return s0, x
+
+            @jax.jit
+            def b(maybe: Optional[TrainState], x):
+                return maybe, x
+            """,
+            "ZNC005",
+        )
+        assert ids(fs) == ["ZNC005", "ZNC005"]
+
+    def test_string_forward_reference_annotation_fires(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def step(ts: "TrainState", x):
+                return ts, x
+            """,
+            "ZNC005",
+        )
+        assert ids(fs) == ["ZNC005"]
+
+    def test_lookalike_type_name_is_quiet(self):
+        # word-boundary matching: TrainStateless is a different type
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def step(ts: "TrainStateless", x):
+                return ts, x
+            """,
+            "ZNC005",
+        )
+        assert fs == []
+
+    def test_annotated_with_donation_is_quiet(self):
+        fs = run(
+            """
+            import jax
+
+            def step(ts: TrainState, x):
+                return ts, x
+
+            fast = jax.jit(step, donate_argnums=(0,))
+            """,
+            "ZNC005",
+        )
+        assert fs == []
+
+    def test_annotated_static_param_is_quiet(self):
+        fs = run(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("ts",))
+            def step(ts: TrainState, x):
+                return ts, x
+            """,
+            "ZNC005",
+        )
+        assert fs == []
+
 
 # -- ZNC006: mutable state -----------------------------------------------
 

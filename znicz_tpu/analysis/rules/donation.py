@@ -5,14 +5,19 @@ state's HBM footprint unless the input buffers are donated
 (``donate_argnums``) — on a memory-bound TPU run that is the difference
 between fitting and OOM, and XLA's in-place update path is also faster.
 The heuristic: a ``jax.jit``/``pjit`` application whose wrapped function
-has a non-static parameter with a state-suggesting name (``state``,
-``train_state``, ``opt_state``) and no donation kwarg.
+has a non-static parameter that is state-shaped — a state-suggesting
+NAME (``state``, ``train_state``, ``opt_state``) or a ``TrainState``
+type ANNOTATION (plain, dotted, wrapped as ``Optional[TrainState]``, or
+a string forward reference), so renaming the parameter does not dodge
+the check — and no donation kwarg.
 """
 
 from __future__ import annotations
 
+import ast
+import re
+
 from znicz_tpu.analysis.rules import Rule, register
-from znicz_tpu.analysis.context import _param_names
 
 _STATE_NAMES = {
     "state",
@@ -21,6 +26,42 @@ _STATE_NAMES = {
     "tstate",
     "optimizer_state",
 }
+# type names that mark a parameter as train state regardless of its name
+_STATE_TYPES = {"TrainState"}
+
+
+def _annotation_is_state(ann: ast.AST) -> bool:
+    """Does the annotation mention a state type anywhere — ``TrainState``,
+    ``train_state.TrainState``, ``Optional[TrainState]``, or the string
+    form ``"TrainState"``?"""
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name) and node.id in _STATE_TYPES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _STATE_TYPES:
+            return True
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # word-boundary match: "Optional[TrainState]" fires,
+            # "TrainStateless" (a different type) does not
+            if any(
+                re.search(rf"\b{t}\b", node.value) for t in _STATE_TYPES
+            ):
+                return True
+    return False
+
+
+def _state_params(fn) -> list:
+    """Parameter names that look state-shaped by NAME or by ANNOTATION
+    (lambdas carry no annotations; the name path still applies)."""
+    args = fn.args
+    out = []
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        by_name = a.arg in _STATE_NAMES
+        by_type = a.annotation is not None and _annotation_is_state(
+            a.annotation
+        )
+        if by_name or by_type:
+            out.append(a.arg)
+    return out
 
 
 @register
@@ -35,16 +76,16 @@ class DonationRule(Rule):
                 continue
             static = jc.static_names()
             hits = [
-                p
-                for p in _param_names(jc.fn)
-                if p in _STATE_NAMES and p not in static
+                p for p in _state_params(jc.fn) if p not in static
             ]
             if hits:
+                name = getattr(jc.fn, "name", "<lambda>")
                 yield self.finding(
                     info,
                     jc.node,
-                    f"jit of '{jc.fn.name}' takes state-shaped "
-                    f"argument(s) {', '.join(hits)} but declares no "
+                    f"jit of '{name}' takes state-shaped "
+                    f"argument(s) {', '.join(hits)} (by name or "
+                    "TrainState annotation) but declares no "
                     "donate_argnums — the old state's buffers stay live "
                     "and double the HBM footprint",
                 )

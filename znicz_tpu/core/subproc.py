@@ -99,7 +99,17 @@ def _worker_warn_shared_chip(payload: Dict[str, Any]) -> None:
             )
         backend = jax.default_backend()
         n_chips = jax.device_count()
-    except Exception:
+    except (ImportError, AttributeError, RuntimeError) as e:
+        # best-effort probe over version-private jax API in the worker
+        # bring-up path: a missing/renamed symbol (ImportError/
+        # AttributeError) or an uninitializable backend (RuntimeError —
+        # the very contention this would warn about) must never break
+        # the run; anything else propagates
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "shared-chip warning probe failed: %s", e
+        )
         return
     if backend in ("tpu", "axon") and n_chips < n:
         print(

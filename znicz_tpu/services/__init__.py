@@ -13,6 +13,10 @@ from znicz_tpu.services.plotting import (  # noqa: F401
     MetricsCSVWriter,
     Weights2D,
 )
+from znicz_tpu.services.engine import (  # noqa: F401
+    Completion,
+    DecodeEngine,
+)
 from znicz_tpu.services.image_saver import ImageSaver  # noqa: F401
 from znicz_tpu.services.publishing import MarkdownReporter  # noqa: F401
 from znicz_tpu.services.web_status import StatusWriter  # noqa: F401

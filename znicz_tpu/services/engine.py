@@ -1,0 +1,471 @@
+"""Continuous micro-batching decode engine: the LM serving front-end.
+
+Orca-style continuous batching (PAPERS.md lineage) over the bucketed
+decode fast path (:mod:`znicz_tpu.workflow.generate`, docs/SERVING.md):
+a request queue coalesces pending prompts into a fixed B-slot batch over
+STATIC [B, T_max] KV buffers; when a row retires (EOS or budget), its
+slot is re-used by prefilling the next queued prompt into it while the
+other rows keep decoding.  Two compiled programs cover any request
+stream:
+
+* **admit** — prefill ONE left-padded [1, bucket] prompt into a fresh
+  zeroed cache row and scatter it into the batch at the slot index; one
+  compile per prompt-length bucket (geometric ladder, so a handful).
+* **decode chunk** — up to ``admit_every`` incremental steps for the
+  whole batch in one ``lax.while_loop`` (early exit once every row is
+  done), with PER-ROW positions (the cache write is vmapped into a
+  scatter), so rows at different depths decode together and no prompt
+  length or admission pattern ever recompiles it.
+
+Per-request latency and tokens/s ride :mod:`znicz_tpu.utils.profiling`
+(a Stopwatch per request, a LatencyStats aggregate, StepTimer phases);
+compile counts are introspectable via
+:meth:`DecodeEngine.compile_stats`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Deque, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.utils import profiling
+from znicz_tpu.workflow.generate import (
+    DEFAULT_PROMPT_BUCKETS,
+    _check_sampling_args,
+    _sample,
+    bucket_for,
+    decode_step,
+    init_kv_cache,
+    pack_prompts,
+    prefill,
+)
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued generation request: a 1-D prompt with its own budget."""
+
+    id: int
+    prompt: np.ndarray  # 1-D int32
+    max_new_tokens: int
+    bucket: int  # prompt-length bucket it will be admitted at
+    watch: profiling.Stopwatch  # started at submit; read at retirement
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: prompt + generated tokens plus its serving
+    metrics.  ``latency_s`` is submit -> retirement (queue wait
+    included — the number a caller actually experiences)."""
+
+    id: int
+    tokens: np.ndarray  # prompt + generated, EOS included when hit
+    n_new: int
+    finish_reason: str  # "eos" | "budget"
+    latency_s: float
+    tokens_per_sec: float
+    bucket: int
+
+
+def _sample_tok(logits, key, temperature, top_p, *, greedy, top_k, nucleus):
+    """Engine twin of the generate() sampler: greedy argmax or the
+    shared truncated-softmax ``_sample`` (structural knobs static)."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return _sample(logits, key, temperature, top_k, nucleus, top_p)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_heads", "greedy", "top_k", "nucleus", "moe_top_k",
+        "moe_dispatch",
+    ),
+    donate_argnums=(1,),
+)
+def _admit_row(
+    params, caches, prompt, start, slot, temperature, top_p, key, *,
+    n_heads, greedy, top_k, nucleus, moe_top_k, moe_dispatch,
+):
+    """Prefill ONE left-padded [1, bucket] prompt into row ``slot`` of
+    the batch caches and sample its first token.
+
+    The row is rebuilt from a fresh ZEROED [1, T_max] cache, so the
+    previous occupant's K/V cannot leak into the new request (causality
+    already guarantees it — a query at position q only attends
+    positions <= q, all rewritten by the current occupant — the zeroed
+    row makes it true by construction too).  Compiles once per prompt
+    bucket (shape-keyed); the slot index is a traced operand."""
+    t_max = caches[0]["k"].shape[1]
+    row = init_kv_cache(params, 1, t_max, n_heads=n_heads)
+    row, logits = prefill(
+        params, prompt, row, n_heads=n_heads, start=start,
+        moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+    )
+    new = []
+    for big, r in zip(caches, row):
+        new.append(
+            {
+                "k": jax.lax.dynamic_update_slice(
+                    big["k"], r["k"], (slot, 0, 0, 0)
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    big["v"], r["v"], (slot, 0, 0, 0)
+                ),
+            }
+        )
+    first = _sample_tok(
+        logits, key, temperature, top_p, greedy=greedy, top_k=top_k,
+        nucleus=nucleus,
+    )
+    return new, first[0]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "chunk", "n_heads", "eos_id", "greedy", "top_k", "nucleus",
+        "moe_top_k", "moe_dispatch",
+    ),
+    donate_argnums=(1,),
+)
+def _decode_chunk(
+    params, caches, tok, pos, start, done, remaining, temperature,
+    top_p, rng, *, chunk, n_heads, eos_id, greedy, top_k, nucleus,
+    moe_top_k, moe_dispatch,
+):
+    """Up to ``chunk`` decode steps for the whole batch in ONE compiled
+    program, exiting early once every row is done.
+
+    Positions are PER-ROW — the cache write is vmapped into a scatter —
+    so rows admitted at different times (different prompt lengths,
+    different depths) decode together, and NO prompt length or admission
+    pattern ever recompiles this program: the zero-recompile core of the
+    engine.  Rows already done emit ``eos_id`` and idle in place (their
+    clamped cache write is dead — the slot is rebuilt at re-admission).
+
+    Returns (caches, tok, pos, done, remaining, out [B, chunk], steps):
+    the host reads ``out[:, :steps]`` to collect emissions and retire
+    rows."""
+    b = tok.shape[0]
+    t_max = caches[0]["k"].shape[1]
+    fill = jnp.int32(eos_id)
+    out = jnp.full((b, chunk), fill, jnp.int32)
+
+    def step_rows(caches, tok, pos):
+        def one(cache_row, t, p, s):
+            c1 = jax.tree_util.tree_map(lambda a: a[None], cache_row)
+            c2, lg = decode_step(
+                params, c1, t[None], p, n_heads=n_heads, start=s[None],
+                moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+            )
+            return jax.tree_util.tree_map(lambda a: a[0], c2), lg[0]
+
+        return jax.vmap(one)(caches, tok, pos, start)
+
+    def cond(carry):
+        i, _, _, _, done, _, _ = carry
+        return (i < chunk) & ~jnp.all(done)
+
+    def body(carry):
+        i, caches, tok, pos, done, remaining, out = carry
+        caches, logits = step_rows(caches, tok, pos)
+        nxt = _sample_tok(
+            logits, jax.random.fold_in(rng, i), temperature, top_p,
+            greedy=greedy, top_k=top_k, nucleus=nucleus,
+        )
+        nxt = jnp.where(done, fill, nxt)
+        remaining = jnp.where(done, remaining, remaining - 1)
+        done = done | (nxt == eos_id) | (remaining <= 0)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+        pos = jnp.minimum(pos + 1, t_max - 1)
+        return (i + 1, caches, nxt, pos, done, remaining, out)
+
+    i, caches, tok, pos, done, remaining, out = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), caches, tok, pos, done, remaining, out),
+    )
+    return caches, tok, pos, done, remaining, out, i
+
+
+class DecodeEngine:
+    """Continuous micro-batching front-end over the KV-cache decoder.
+
+    Usage::
+
+        eng = DecodeEngine(params, n_heads=8, eos_id=0, batch_size=8)
+        ids = [eng.submit(prompt, max_new_tokens=64) for prompt in reqs]
+        completions = eng.run()          # drain the queue
+        eng.stats()                      # latency / tokens/s / compiles
+
+    Greedy by default; ``temperature``/``top_k``/``top_p`` select the
+    same sampling structures as :func:`generate` (one compiled program
+    set per structure).  ``admit_every`` is the admission granularity:
+    the batch decodes in chunks of that many steps between retirement
+    checks — small values admit sooner, large values sync less."""
+
+    def __init__(
+        self,
+        params,
+        *,
+        n_heads: int,
+        eos_id: int,
+        batch_size: int = 8,
+        max_seq: Optional[int] = None,
+        prompt_buckets: Sequence[int] = DEFAULT_PROMPT_BUCKETS,
+        admit_every: int = 8,
+        pad_id: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        rng: Optional[jax.Array] = None,
+        moe_top_k: int = 1,
+        moe_dispatch: str = "dense",
+    ):
+        if batch_size < 1 or admit_every < 1:
+            raise ValueError(
+                f"want batch_size >= 1 and admit_every >= 1; got "
+                f"{batch_size}, {admit_every}"
+            )
+        max_pos = params[0]["pos"].shape[0]
+        self.t_max = int(max_seq or max_pos)
+        if self.t_max > max_pos:
+            raise ValueError(
+                f"max_seq {self.t_max} exceeds the positional table "
+                f"({max_pos})"
+            )
+        top_k, rng = _check_sampling_args(
+            params, temperature, top_k, top_p, rng, eos_id
+        )
+        self.params = params
+        self.n_heads = n_heads
+        self.eos_id = int(eos_id)
+        self.pad_id = int(pad_id if pad_id is not None else eos_id)
+        self.batch_size = int(batch_size)
+        self.prompt_buckets = tuple(prompt_buckets)
+        self.admit_every = int(admit_every)
+        self.moe_top_k = moe_top_k
+        self.moe_dispatch = moe_dispatch
+        self._temperature = jnp.float32(temperature)
+        self._top_p = jnp.float32(top_p)
+        self._rng = rng
+        # static sampling structure: one compiled program set per value
+        self._structure = (temperature == 0.0, top_k, top_p < 1.0)
+        self._caches = init_kv_cache(
+            params, self.batch_size, self.t_max, n_heads=n_heads
+        )
+        b = self.batch_size
+        self._tok = np.zeros((b,), np.int32)
+        self._pos = np.zeros((b,), np.int32)
+        self._start = np.zeros((b,), np.int32)
+        self._done = np.ones((b,), bool)  # empty slots idle as done
+        self._remaining = np.zeros((b,), np.int32)
+        self._slots: List[Optional[dict]] = [None] * b
+        self._queue: Deque[Request] = deque()
+        self._order: List[Completion] = []
+        self.completions: Dict[int, Completion] = {}
+        self.latency = profiling.LatencyStats()
+        self.timer = profiling.StepTimer()
+        self._programs: Dict[tuple, int] = {}
+        self._program_hits = 0
+        self._next_id = 0
+        self._n_admits = 0
+        self._chunk_idx = 0
+        self._total_new = 0
+
+    # -- request intake ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Queue one prompt (1-D token ids); returns the request id.
+        Validated against the static KV capacity at its bucket size, so
+        admission can never fail later."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        if p.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"want max_new_tokens >= 1; got {max_new_tokens}")
+        bucket = bucket_for(p.size, self.prompt_buckets)
+        if bucket + max_new_tokens > self.t_max:
+            raise ValueError(
+                f"prompt bucket {bucket} (len {p.size}) + max_new_tokens "
+                f"{max_new_tokens} exceeds the KV buffer ({self.t_max})"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append(
+            Request(rid, p, int(max_new_tokens), bucket,
+                    profiling.Stopwatch())
+        )
+        return rid
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    # -- the serving loop -------------------------------------------------
+
+    def run(self) -> List[Completion]:
+        """Drain the queue: admit into free slots, decode in chunks,
+        retire finished rows, re-admit — until every submitted request
+        has completed.  Returns this call's completions in retirement
+        order (also kept in :attr:`completions` by id)."""
+        n0 = len(self._order)
+        while self._queue or self.active:
+            self._admit_pending()
+            if not self.active:
+                continue  # everything admitted retired instantly
+            self._run_chunk()
+        return self._order[n0:]
+
+    def _program(self, key: tuple) -> None:
+        """Ledger one executable per key: the compile-count hook's
+        ground truth (tests cross-check it against the jit cache)."""
+        if key in self._programs:
+            self._program_hits += 1
+        else:
+            self._programs[key] = 1
+
+    def _admit_pending(self) -> None:
+        for slot in range(self.batch_size):
+            # keep pulling from the queue until the slot holds an ACTIVE
+            # row: a request that retires at admission itself (first
+            # token is EOS, or budget 1) must not idle the slot for a
+            # whole decode chunk
+            while self._queue and self._slots[slot] is None:
+                self._admit_into(slot, self._queue.popleft())
+
+    def _admit_into(self, slot: int, req: Request) -> None:
+        with self.timer.phase("admit"):
+            tokens, start = pack_prompts(
+                [req.prompt], req.bucket, self.pad_id
+            )
+            self._program(("admit", req.bucket, self._structure))
+            key = jax.random.fold_in(self._rng, self._n_admits)
+            self._n_admits += 1
+            greedy, top_k, nucleus = self._structure
+            self._caches, first = _admit_row(
+                self.params, self._caches, tokens, start,
+                jnp.int32(slot), self._temperature, self._top_p, key,
+                n_heads=self.n_heads, greedy=greedy, top_k=top_k,
+                nucleus=nucleus, moe_top_k=self.moe_top_k,
+                moe_dispatch=self.moe_dispatch,
+            )
+            first = int(first)
+        if first == self.eos_id:
+            self._retire(req, [first], "eos")
+        elif req.max_new_tokens == 1:
+            self._retire(req, [first], "budget")
+        else:
+            self._slots[slot] = {"req": req, "emitted": [first]}
+            self._tok[slot] = first
+            self._pos[slot] = req.bucket
+            self._start[slot] = req.bucket - req.prompt.size
+            self._done[slot] = False
+            self._remaining[slot] = req.max_new_tokens - 1
+
+    def _run_chunk(self) -> None:
+        with self.timer.phase("decode"):
+            rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
+            self._chunk_idx += 1
+            greedy, top_k, nucleus = self._structure
+            self._program(
+                ("chunk", self.admit_every, self.batch_size,
+                 self._structure)
+            )
+            (caches, tok, pos, done, remaining, out, steps) = _decode_chunk(
+                self.params, self._caches, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._start),
+                jnp.asarray(self._done), jnp.asarray(self._remaining),
+                self._temperature, self._top_p, rng,
+                chunk=self.admit_every, n_heads=self.n_heads,
+                eos_id=self.eos_id, greedy=greedy, top_k=top_k,
+                nucleus=nucleus, moe_top_k=self.moe_top_k,
+                moe_dispatch=self.moe_dispatch,
+            )
+            self._caches = caches
+            # ONE host sync per chunk — the admission granularity; the
+            # [B]-sized state and [B, chunk] emissions are tiny next to
+            # the device-resident KV buffers
+            out = np.asarray(out)
+            steps = int(steps)
+            # np.array (not asarray): host state stays mutable — asarray
+            # of a device array is a read-only view
+            self._tok = np.array(tok)
+            self._pos = np.array(pos)
+            self._done = np.array(done)
+            self._remaining = np.array(remaining)
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            req, emitted = st["req"], st["emitted"]
+            reason = None
+            for t in out[slot, :steps]:
+                emitted.append(int(t))
+                if int(t) == self.eos_id:
+                    reason = "eos"
+                    break
+                if len(emitted) >= req.max_new_tokens:
+                    reason = "budget"
+                    break
+            if reason is not None:
+                self._retire(req, emitted, reason)
+                self._slots[slot] = None
+                self._done[slot] = True
+                self._remaining[slot] = 0
+
+    def _retire(self, req: Request, emitted: List[int], reason: str):
+        dt = req.watch.elapsed()
+        comp = Completion(
+            id=req.id,
+            tokens=np.concatenate(
+                [req.prompt, np.asarray(emitted, np.int32)]
+            ),
+            n_new=len(emitted),
+            finish_reason=reason,
+            latency_s=dt,
+            tokens_per_sec=len(emitted) / max(dt, 1e-9),
+            bucket=req.bucket,
+        )
+        self._order.append(comp)
+        self.completions[req.id] = comp
+        self.latency.record(dt)
+        self._total_new += len(emitted)
+
+    # -- introspection ----------------------------------------------------
+
+    def compile_stats(self) -> Dict:
+        """Compile-count hook: ``programs`` maps each
+        ``("admit", bucket, structure)`` / ``("chunk", chunk, B,
+        structure)`` key to 1 — one executable per key over the engine's
+        lifetime; ``program_hits`` counts invocations that reused one.
+        ``*_jit_entries`` are the process-wide jax caches backing them
+        (shared across engines: a second engine with the same geometry
+        compiles nothing new)."""
+        return {
+            "programs": dict(self._programs),
+            "n_programs": len(self._programs),
+            "program_hits": self._program_hits,
+            "admit_jit_entries": _admit_row._cache_size(),
+            "chunk_jit_entries": _decode_chunk._cache_size(),
+        }
+
+    def stats(self) -> Dict:
+        """Serving report: completions, generated tokens, the per-request
+        latency aggregate, per-phase host timings, and compile counts."""
+        return {
+            "completed": len(self.completions),
+            "generated_tokens": self._total_new,
+            "latency": self.latency.summary(),
+            "phases": self.timer.summary(),
+            **self.compile_stats(),
+        }

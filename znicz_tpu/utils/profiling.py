@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Iterator
+from typing import Dict, Iterator, List
 
 
 @contextlib.contextmanager
@@ -49,6 +49,43 @@ class Stopwatch:
     def elapsed(self) -> float:
         """Seconds since construction (or the last :meth:`reset`)."""
         return time.monotonic() - self._t0
+
+
+class LatencyStats:
+    """Order-statistics aggregate for per-request latencies.
+
+    The serving engine (services/engine.py) records one sample per
+    retired request; the summary is what the serve bench and status
+    surfaces report.  Plain Python like the rest of this module — no
+    numpy dependency for a handful of floats."""
+
+    def __init__(self):
+        self._samples: List[float] = []
+
+    def record(self, seconds: float) -> None:
+        self._samples.append(float(seconds))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def summary(self) -> Dict[str, float]:
+        if not self._samples:
+            return {"count": 0}
+        s = sorted(self._samples)
+
+        def pct(p: float) -> float:
+            return s[min(len(s) - 1, int(round(p * (len(s) - 1))))]
+
+        return {
+            "count": len(s),
+            "mean_ms": 1000.0 * sum(s) / len(s),
+            "p50_ms": 1000.0 * pct(0.5),
+            "p95_ms": 1000.0 * pct(0.95),
+            "max_ms": 1000.0 * s[-1],
+        }
+
+    def reset(self) -> None:
+        self._samples.clear()
 
 
 class StepTimer:

@@ -7,8 +7,19 @@ is a STATIC-shape [B, T_max, H, hd] buffer per block (XLA wants fixed
 shapes; validity is an index mask, not a dynamic length), each decode step
 is one position through the block tower (``jax.lax.dynamic_update_slice``
 into the cache, attention over the full buffer masked to ``<= pos``), and
-the whole generation loop is ONE ``lax.scan`` — a single compiled program,
-no per-token dispatch.
+the whole generation loop is ONE ``lax.while_loop`` — a single compiled
+program, no per-token dispatch, that exits as soon as every row has hit
+the EOS id (or the budget).
+
+Serving fast path (docs/SERVING.md): prompts are LEFT-padded to a small
+geometric ladder of length buckets and budgets round up a rung, so any
+request stream hits a handful of compiled programs instead of one per
+shape.  Padding is numerically inert — per-row ``start`` offsets mask the
+pad slots out of attention and shift positional embeddings, which the
+golden tests assert against the unpadded reference position-by-position.
+:func:`generate_serve` fronts this with an explicit executable cache
+keyed on ``(bucket_tp, bucket_new, B, sampling-structure)`` and a
+compile-count introspection hook (:func:`serve_cache_stats`).
 
 Numerics match :func:`znicz_tpu.workflow.transformer.lm_apply` exactly
 (same projection/attention formulation, f32 accumulation), which the golden
@@ -18,7 +29,7 @@ tests assert position-by-position.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -43,14 +54,17 @@ def init_kv_cache(params, batch: int, max_seq: int, *, n_heads: int):
 
 
 def _block_step(
-    block, x, cache, offset, *, n_heads, moe_top_k=1, moe_dispatch="dense"
+    block, x, cache, offset, *, n_heads, start=None, moe_top_k=1,
+    moe_dispatch="dense",
 ):
     """One pre-LN block over ``x`` [B, Tq, D] at absolute positions
     ``offset .. offset+Tq-1``, reading/writing the KV cache.  Tq is the
     prompt length during prefill and 1 during decode — one definition for
     both, so they cannot drift from each other (and the attention math
     mirrors ``ops.attention.mha`` + ``dot_product_attention``: f32 score
-    accumulation, stable softmax)."""
+    accumulation, stable softmax).  ``start`` [B] marks each row's first
+    real (non-pad) position under left-padding; keys before it are masked
+    out of attention."""
     b, tq, _ = x.shape
     h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
 
@@ -70,7 +84,16 @@ def _block_step(
     # (unwritten cache slots are > offset+Tq-1, so they mask out too)
     k_idx = jnp.arange(t_max)[None, None, None, :]
     q_idx = offset + jnp.arange(tq)[None, None, :, None]
-    s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
+    valid = k_idx <= q_idx
+    if start is not None:
+        # left-padding: keys before the row's first real token are inert.
+        # A pad-region query (q < start) keeps exactly its own position so
+        # its softmax stays finite (all--inf rows would breed NaNs that
+        # 0*NaN-poison real rows through the value einsum); its output is
+        # discarded and its k/v never enter a real query's window.
+        st = start[:, None, None, None]
+        valid = valid & (k_idx >= jnp.minimum(st, q_idx))
+    s = jnp.where(valid, s, -jnp.inf)
     p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
     p = p / jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum(
@@ -88,23 +111,35 @@ def _block_step(
     return x, {"k": k_cache, "v": v_cache}
 
 
-def _embed_at(embed, tokens, offset):
-    """Token + positional embedding for tokens [B, Tq] at ``offset``."""
+def _embed_at(embed, tokens, offset, start=None):
+    """Token + positional embedding for tokens [B, Tq] at ``offset``.
+
+    With ``start`` [B] (left-padding), each row's positional index is
+    RELATIVE to its first real token (absolute - start), so a padded row
+    sees exactly the position ids the unpadded prompt would — positional
+    parity is what makes left-padding numerically inert."""
     tq = tokens.shape[1]
-    pos = jax.lax.dynamic_slice_in_dim(embed["pos"], offset, tq, axis=0)
-    return embed["embed"][tokens] + pos[None, :, :]
+    if start is None:
+        pos = jax.lax.dynamic_slice_in_dim(embed["pos"], offset, tq, axis=0)
+        return embed["embed"][tokens] + pos[None, :, :]
+    rel = offset + jnp.arange(tq)[None, :] - start[:, None]
+    rel = jnp.clip(rel, 0, embed["pos"].shape[0] - 1)
+    return embed["embed"][tokens] + embed["pos"][rel]
 
 
 def prefill(
-    params, tokens, caches, *, n_heads, moe_top_k=1, moe_dispatch="dense"
+    params, tokens, caches, *, n_heads, start=None, moe_top_k=1,
+    moe_dispatch="dense",
 ):
     """Run the prompt [B, Tp] through the tower, filling positions
-    ``0..Tp-1`` of the caches; returns (caches, last-position logits)."""
-    x = _embed_at(params[0], tokens, 0)
+    ``0..Tp-1`` of the caches; returns (caches, last-position logits).
+    ``start`` [B]: first real position per row of a LEFT-padded prompt
+    (the last position is always real, so the returned logits are too)."""
+    x = _embed_at(params[0], tokens, 0, start)
     new_caches = []
     for block, cache in zip(params[1:-1], caches):
         x, cache = _block_step(
-            block, x, cache, 0, n_heads=n_heads,
+            block, x, cache, 0, n_heads=n_heads, start=start,
             moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
         )
         new_caches.append(cache)
@@ -112,15 +147,16 @@ def prefill(
 
 
 def decode_step(
-    params, caches, token, pos, *, n_heads, moe_top_k=1, moe_dispatch="dense"
+    params, caches, token, pos, *, n_heads, start=None, moe_top_k=1,
+    moe_dispatch="dense",
 ):
     """One incremental step: ``token`` [B] at position ``pos`` -> (caches,
     next-position logits [B, vocab])."""
-    x = _embed_at(params[0], token[:, None], pos)
+    x = _embed_at(params[0], token[:, None], pos, start)
     new_caches = []
     for block, cache in zip(params[1:-1], caches):
         x, cache = _block_step(
-            block, x, cache, pos, n_heads=n_heads,
+            block, x, cache, pos, n_heads=n_heads, start=start,
             moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
         )
         new_caches.append(cache)
@@ -152,12 +188,38 @@ def _sample(logits, key, temperature, top_k, nucleus, top_p):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _check_sampling_args(params, temperature, top_k, top_p, rng, eos_id):
+    """Shared argument validation for generate()/generate_serve()/the
+    engine; returns (top_k, rng) with the full-support clamp and greedy
+    dummy key applied."""
+    if temperature != 0.0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"want top_k >= 0 and 0 < top_p <= 1; got {top_k}, {top_p}"
+        )
+    vocab = params[-1]["head"].shape[-1]
+    if eos_id is not None and not 0 <= eos_id < vocab:
+        raise ValueError(f"eos_id {eos_id} outside vocab {vocab}")
+    if top_k >= vocab:
+        top_k = 0  # full support — no truncation (mirrors moe's clamp)
+    if rng is None:
+        # only reachable in greedy mode (temperature != 0 raised above),
+        # where the key is NEVER consumed — the loop just wants a
+        # key-typed operand.  A registry draw here would advance (and
+        # snapshot) a stream nothing reads; a fixed dummy is the honest
+        # spelling, same pattern as ops/pallas/rbm.py.
+        rng = jax.random.key(0)  # znicz-check: disable=ZNC004
+    return top_k, rng
+
+
 def generate(
     params,
     prompt: jnp.ndarray,  # [B, Tp] int32
     *,
     n_heads: int,
     max_new_tokens: int,
+    eos_id: Optional[int] = None,
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
@@ -169,11 +231,16 @@ def generate(
     (prompt included).  ``temperature=0`` is greedy argmax; otherwise
     softmax sampling at the given temperature (``rng`` required),
     optionally truncated to the ``top_k`` highest logits and/or the
-    ``top_p`` nucleus.  The decode loop is one ``lax.scan`` — per-token
-    cost is one cached block-tower step, not a growing re-forward.
+    ``top_p`` nucleus.  The decode loop is one ``lax.while_loop`` —
+    per-token cost is one cached block-tower step, not a growing
+    re-forward, and with ``eos_id`` set the loop EXITS as soon as every
+    row has emitted EOS (rows that finish early emit ``eos_id`` for the
+    rest of the budget, identical to the full-budget run up to EOS).
     ``temperature``/``top_p`` are traced operands: sweeping them reuses
     one compiled program (only greedy<->sampling, top_k, the nucleus
-    on/off flag and shapes recompile)."""
+    on/off flag, ``eos_id`` and shapes recompile)."""
+    if max_new_tokens < 1:
+        raise ValueError(f"want max_new_tokens >= 1; got {max_new_tokens}")
     tp = prompt.shape[1]
     t_max = tp + max_new_tokens
     max_pos = params[0]["pos"].shape[0]
@@ -183,25 +250,14 @@ def generate(
             f"positional table ({max_pos}); re-init the LM with a larger "
             "max_seq"
         )
-    if temperature != 0.0 and rng is None:
-        raise ValueError("temperature > 0 needs an rng key")
-    if top_k < 0 or not 0.0 < top_p <= 1.0:
-        raise ValueError(
-            f"want top_k >= 0 and 0 < top_p <= 1; got {top_k}, {top_p}"
-        )
-    vocab = params[-1]["head"].shape[-1]
-    if top_k >= vocab:
-        top_k = 0  # full support — no truncation (mirrors moe's clamp)
-    if rng is None:
-        # only reachable in greedy mode (temperature != 0 raised above),
-        # where the key is NEVER consumed — the scan just wants a
-        # key-typed operand.  A registry draw here would advance (and
-        # snapshot) a stream nothing reads; a fixed dummy is the honest
-        # spelling, same pattern as ops/pallas/rbm.py.
-        rng = jax.random.key(0)  # znicz-check: disable=ZNC004
+    top_k, rng = _check_sampling_args(
+        params, temperature, top_k, top_p, rng, eos_id
+    )
     return _generate_impl(
         params,
         jnp.asarray(prompt, jnp.int32),
+        None,
+        jnp.int32(max_new_tokens),
         jnp.float32(temperature),
         jnp.float32(top_p),
         rng,
@@ -210,6 +266,7 @@ def generate(
         greedy=temperature == 0.0,
         top_k=top_k,
         nucleus=top_p < 1.0,
+        eos_id=eos_id,
         moe_top_k=moe_top_k,
         moe_dispatch=moe_dispatch,
     )
@@ -219,42 +276,260 @@ def generate(
     jax.jit,
     static_argnames=(
         "n_heads", "max_new_tokens", "greedy", "top_k", "nucleus",
-        "moe_top_k", "moe_dispatch",
+        "eos_id", "moe_top_k", "moe_dispatch",
     ),
 )
 def _generate_impl(
-    params, prompt, temperature, top_p, rng, *, n_heads, max_new_tokens,
-    greedy, top_k, nucleus, moe_top_k, moe_dispatch,
+    params, prompt, start, budget, temperature, top_p, rng, *, n_heads,
+    max_new_tokens, greedy, top_k, nucleus, eos_id, moe_top_k,
+    moe_dispatch,
 ):
+    """One compiled decode program: prefill + a while_loop over decode
+    steps carrying a per-row done-mask.  ``start`` is None for unpadded
+    prompts (None is an empty pytree, so the lean no-mask program
+    compiles) or [B] first-real-position offsets for left-padded ones.
+    ``budget`` is the REQUESTED token count as a traced operand:
+    ``max_new_tokens`` (the budget-ladder rung) sizes the buffers, but
+    the loop stops at ``budget`` — rounding a request up a rung costs
+    compiled shapes, never decode steps.  Per-step sampling keys are
+    ``fold_in(rng, step)`` — derivable at any step index without
+    materializing a presplit key array in the carry."""
     b, tp = prompt.shape
     t_max = tp + max_new_tokens
+    budget = jnp.minimum(budget, max_new_tokens)  # out-buffer bound
 
-    def sample(logits, key):
+    def sample(logits, i):
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return _sample(logits, key, temperature, top_k, nucleus, top_p)
+        return _sample(
+            logits, jax.random.fold_in(rng, i), temperature, top_k,
+            nucleus, top_p,
+        )
 
     caches = init_kv_cache(params, b, t_max, n_heads=n_heads)
     caches, logits = prefill(
-        params, prompt, caches, n_heads=n_heads,
+        params, prompt, caches, n_heads=n_heads, start=start,
         moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
     )
-    keys = jax.random.split(rng, max_new_tokens)
-    first = sample(logits, keys[0])
+    first = sample(logits, 0)
+    fill = jnp.int32(eos_id if eos_id is not None else 0)
+    out = jnp.full((b, max_new_tokens), fill, jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, first[:, None], (0, 0))
+    if eos_id is not None:
+        done = first == eos_id
+    else:
+        done = jnp.zeros((b,), bool)
 
-    def step(carry, key):
-        caches, token, pos = carry
+    def cond(carry):
+        _, _, i, done, _ = carry
+        return (i < budget) & ~jnp.all(done)
+
+    def body(carry):
+        caches, token, i, done, out = carry
         caches, logits = decode_step(
-            params, caches, token, pos, n_heads=n_heads,
-            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+            params, caches, token, tp + i - 1, n_heads=n_heads,
+            start=start, moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
         )
-        nxt = sample(logits, key)
-        return (caches, nxt, pos + 1), nxt
+        nxt = sample(logits, i)
+        if eos_id is not None:
+            nxt = jnp.where(done, fill, nxt)
+            done = done | (nxt == eos_id)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+        return (caches, nxt, i + 1, done, out)
 
-    (_, _, _), rest = jax.lax.scan(
-        step, (caches, first, jnp.asarray(tp)), keys[1:]
+    _, _, _, _, out = jax.lax.while_loop(
+        cond, body, (caches, first, jnp.int32(1), done, out)
     )
-    out = jnp.concatenate(
-        [prompt, first[:, None], rest.T.astype(jnp.int32)], axis=1
+    return jnp.concatenate([prompt, out], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Serving fast path: shape buckets + an explicit executable cache.
+
+# Geometric x2 ladders: a request stream of arbitrary prompt lengths /
+# token budgets compiles at most len(ladder) programs per sampling
+# structure instead of one per distinct shape.
+DEFAULT_PROMPT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+DEFAULT_BUDGET_LADDER = (16, 32, 64, 128, 256, 512, 1024)
+
+
+def bucket_for(n: int, ladder: Sequence[int]) -> int:
+    """Smallest rung >= ``n``; past the top rung keep doubling it, so the
+    ladder stays geometric and the compiled-program count logarithmic in
+    the largest request ever seen."""
+    if n <= 0:
+        raise ValueError(f"want a positive length; got {n}")
+    for rung in ladder:
+        if n <= rung:
+            return int(rung)
+    rung = int(ladder[-1])
+    while rung < n:
+        rung *= 2
+    return rung
+
+
+def pack_prompts(prompts, bucket: int, pad_id: int):
+    """LEFT-pad ragged prompts into one [B, bucket] int32 batch.
+
+    Returns ``(tokens, start)`` where ``start[b]`` is the index of row
+    b's first real token — the attention mask and positional embeddings
+    consume it to make the padding numerically inert (left-padding keeps
+    every row's LAST position real, so prefill logits need no gather)."""
+    tokens = np.full((len(prompts), bucket), pad_id, np.int32)
+    start = np.zeros((len(prompts),), np.int32)
+    for i, p in enumerate(prompts):
+        p = np.asarray(p, np.int32).reshape(-1)
+        if p.size == 0:
+            raise ValueError(f"prompt {i} is empty")
+        if p.size > bucket:
+            raise ValueError(
+                f"prompt {i} length {p.size} exceeds bucket {bucket}"
+            )
+        tokens[i, bucket - p.size:] = p
+        start[i] = bucket - p.size
+    return jnp.asarray(tokens), jnp.asarray(start)
+
+
+def _params_fingerprint(params):
+    """Hashable (treedef, shapes/dtypes) key component: one executable
+    serves one parameter GEOMETRY (values may change, e.g. after more
+    training — shapes may not)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    return treedef, tuple(
+        (tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves
     )
-    return out[:, : t_max]
+
+
+class _ServeCache:
+    """Explicit executable cache for the serving decode path.
+
+    ``jax.jit`` already memoizes by (shapes, statics); this layer makes
+    the serving contract INSPECTABLE: every distinct key is one real
+    AOT-compiled executable (``lower().compile()``), so ``programs`` is
+    an exact compile count, not an inference from timing."""
+
+    def __init__(self):
+        self.programs = {}  # key -> compiled executable
+        self.hits = 0
+        self.requests = 0
+
+    def reset(self):
+        self.programs.clear()
+        self.hits = 0
+        self.requests = 0
+
+
+_serve_cache = _ServeCache()
+
+
+def serve_cache_stats() -> dict:
+    """Compile-count introspection hook for the serving path: one entry
+    in ``programs`` per (bucket_tp, bucket_new, B, sampling-structure)
+    ever compiled; ``hits`` counts requests served without compiling."""
+    return {
+        "programs": len(_serve_cache.programs),
+        "hits": _serve_cache.hits,
+        "requests": _serve_cache.requests,
+        "keys": sorted(
+            str(k[:-1]) for k in _serve_cache.programs
+        ),  # drop the params fingerprint — noise for humans
+        "jit_entries": _generate_impl._cache_size(),
+    }
+
+
+def reset_serve_cache() -> None:
+    """Drop all cached serving executables and zero the counters."""
+    _serve_cache.reset()
+
+
+def generate_serve(
+    params,
+    prompt,  # [B, Tp] int32 (rectangular; ragged streams -> engine.py)
+    *,
+    n_heads: int,
+    max_new_tokens: int,
+    eos_id: Optional[int] = None,
+    pad_id: Optional[int] = None,
+    prompt_buckets: Sequence[int] = DEFAULT_PROMPT_BUCKETS,
+    budget_ladder: Sequence[int] = DEFAULT_BUDGET_LADDER,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: Optional[jax.Array] = None,
+    moe_top_k: int = 1,
+    moe_dispatch: str = "dense",
+):
+    """Shape-bucketed serving twin of :func:`generate`.
+
+    Left-pads the prompt to the next prompt-length bucket and rounds the
+    token budget up a ladder rung, so any request stream hits a handful
+    of compiled programs; the executable is fetched from (or AOT-compiled
+    into) the explicit :data:`_serve_cache` keyed on
+    ``(bucket_tp, bucket_new, B, sampling-structure)``.  Returns
+    [B, Tp + max_new_tokens] tokens exactly like ``generate()`` — padding
+    stripped, budget trimmed back to the request — and matches it
+    token-for-token up to EOS (golden-tested)."""
+    if max_new_tokens < 1:
+        raise ValueError(f"want max_new_tokens >= 1; got {max_new_tokens}")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, tp = prompt.shape
+    max_pos = params[0]["pos"].shape[0]
+    if tp + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt {tp} + max_new_tokens {max_new_tokens} exceeds the "
+            f"positional table ({max_pos}); re-init the LM with a larger "
+            "max_seq"
+        )
+    bucket_tp = bucket_for(tp, prompt_buckets)
+    bucket_new = bucket_for(max_new_tokens, budget_ladder)
+    if bucket_tp + bucket_new > max_pos:
+        # rounding up must never reject a feasible request: shrink the
+        # budget rung into the table, then fall back to exact shapes
+        # (a rare capacity-edge compile beats a refused request)
+        bucket_new = max_pos - bucket_tp
+        if bucket_new < max_new_tokens:
+            bucket_tp, bucket_new = tp, max_new_tokens
+    top_k, rng = _check_sampling_args(
+        params, temperature, top_k, top_p, rng, eos_id
+    )
+    if pad_id is None:
+        pad_id = eos_id if eos_id is not None else 0
+    pad = bucket_tp - tp
+    if pad:
+        padded = jnp.concatenate(
+            [jnp.full((b, pad), pad_id, jnp.int32), prompt], axis=1
+        )
+    else:
+        padded = prompt
+    # always pass start (even all-zeros at exact bucket size) so ONE
+    # program per bucket serves every prompt length inside it
+    start = jnp.full((b,), pad, jnp.int32)
+    greedy = temperature == 0.0
+    nucleus = top_p < 1.0
+    # n_heads is in the key although it rarely differs between equal
+    # param geometries: head splits of the same [D, D] projections
+    # compile DIFFERENT programs, and a shared-shape cache hit across
+    # head counts would be silently wrong
+    key = (
+        bucket_tp, bucket_new, b, n_heads, greedy, top_k, nucleus,
+        eos_id, moe_top_k, moe_dispatch, _params_fingerprint(params),
+    )
+    temperature = jnp.float32(temperature)
+    top_p = jnp.float32(top_p)
+    _serve_cache.requests += 1
+    # the rung sizes the compiled buffers; the REQUESTED budget rides in
+    # as a traced operand, so the loop never decodes past the request
+    budget = jnp.int32(max_new_tokens)
+    compiled = _serve_cache.programs.get(key)
+    if compiled is None:
+        compiled = _generate_impl.lower(
+            params, padded, start, budget, temperature, top_p, rng,
+            n_heads=n_heads, max_new_tokens=bucket_new, greedy=greedy,
+            top_k=top_k, nucleus=nucleus, eos_id=eos_id,
+            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+        ).compile()
+        _serve_cache.programs[key] = compiled
+    else:
+        _serve_cache.hits += 1
+    out = compiled(params, padded, start, budget, temperature, top_p, rng)
+    return out[:, pad: pad + tp + max_new_tokens]

@@ -645,6 +645,7 @@ class TransformerLMWorkflow(Workflow):
         prompt,
         *,
         max_new_tokens: int,
+        eos_id: Optional[int] = None,
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
@@ -653,7 +654,9 @@ class TransformerLMWorkflow(Workflow):
         """KV-cache autoregressive generation from the CURRENT trained
         params (:mod:`znicz_tpu.workflow.generate`); returns
         [B, Tp + max_new_tokens] tokens, prompt included.  Greedy at
-        ``temperature=0``.  Non-pipelined params only (the pipelined
+        ``temperature=0``; with ``eos_id`` the decode loop exits once
+        every row has emitted EOS (rows pad the rest of the budget with
+        it).  Non-pipelined params only (the pipelined
         stacked-stage layout trains; export/decode from a non-pipelined
         run, like ``export_lm_model``).  Decode attention runs f32
         regardless of ``attention_dtype`` — that knob is a training-
@@ -674,6 +677,7 @@ class TransformerLMWorkflow(Workflow):
             jnp.asarray(prompt, jnp.int32),
             n_heads=self.n_heads,
             max_new_tokens=max_new_tokens,
+            eos_id=eos_id,
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
