@@ -50,6 +50,20 @@ def _model_flops_per_image(layers, input_shape) -> float:
     return total
 
 
+def _metrics_snapshot() -> dict:
+    """The process-wide telemetry registry, attached to every bench
+    record (success or error) so each number carries the serve/train
+    counters and latency histograms behind it."""
+    try:
+        from znicz_tpu.observability import get_registry
+
+        return get_registry().snapshot()
+    except Exception as e:
+        # the record must still print even if telemetry import breaks
+        print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
+        return {}
+
+
 def main() -> None:
     """Run the bench; on ANY failure (backend init included — e.g. the
     relay TPU being unavailable) print ONE parseable JSON error line
@@ -58,7 +72,15 @@ def main() -> None:
     try:
         _bench()
     except Exception as e:
-        print(json.dumps({"error": type(e).__name__, "detail": str(e)[:500]}))
+        print(
+            json.dumps(
+                {
+                    "error": type(e).__name__,
+                    "detail": str(e)[:500],
+                    "metrics_snapshot": _metrics_snapshot(),
+                }
+            )
+        )
         print(f"bench failed: {type(e).__name__}: {e}", file=sys.stderr)
         raise SystemExit(1)
 
@@ -826,6 +848,9 @@ def _bench() -> None:
                 ),
                 "lm_long_tokens_per_sec": round(lm_long, 1),
                 "device": str(jax.devices()[0].device_kind),
+                # full telemetry registry behind this run's numbers:
+                # phase histograms, serve counters/latency, cache stats
+                "metrics_snapshot": _metrics_snapshot(),
             }
         )
     )

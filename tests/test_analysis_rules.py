@@ -1136,6 +1136,136 @@ class TestSwallowedExceptions:
         assert fs == []
 
 
+# -- ZNC009: wall-clock durations ----------------------------------------
+
+
+class TestWallClockDuration:
+    def test_direct_subtraction_fires(self):
+        fs = run(
+            """
+            import time
+
+            def f(t0):
+                return time.time() - t0
+            """,
+            "ZNC009",
+        )
+        assert ids(fs) == ["ZNC009"]
+
+    def test_reversed_direct_subtraction_fires(self):
+        fs = run(
+            """
+            import time
+
+            def remaining(deadline):
+                return deadline - time.time()
+            """,
+            "ZNC009",
+        )
+        assert ids(fs) == ["ZNC009"]
+
+    def test_variable_pair_fires(self):
+        fs = run(
+            """
+            import time
+
+            def f(work):
+                t0 = time.time()
+                work()
+                t1 = time.time()
+                return t1 - t0
+            """,
+            "ZNC009",
+        )
+        assert ids(fs) == ["ZNC009"]
+
+    def test_attribute_pair_fires(self):
+        fs = run(
+            """
+            import time
+
+            class Watch:
+                def start(self):
+                    self._t0 = time.time()
+
+                def lap(self):
+                    self._t1 = time.time()
+                    return self._t1 - self._t0
+            """,
+            "ZNC009",
+        )
+        assert ids(fs) == ["ZNC009"]
+
+    def test_from_import_alias_fires(self):
+        fs = run(
+            """
+            from time import time
+
+            def f(t0):
+                return time() - t0
+            """,
+            "ZNC009",
+        )
+        assert ids(fs) == ["ZNC009"]
+
+    def test_timestamp_use_is_quiet(self):
+        fs = run(
+            """
+            import time
+
+            def stamp(record):
+                record["created_at"] = time.time()
+                return record
+            """,
+            "ZNC009",
+        )
+        assert fs == []
+
+    def test_monotonic_and_perf_counter_quiet(self):
+        fs = run(
+            """
+            import time
+
+            def f(work):
+                t0 = time.monotonic()
+                p0 = time.perf_counter()
+                work()
+                return time.monotonic() - t0, time.perf_counter() - p0
+            """,
+            "ZNC009",
+        )
+        assert fs == []
+
+    def test_unrelated_names_quiet(self):
+        # a subtraction of two NON-wall names in a module that also
+        # calls time.time() elsewhere must not fire
+        fs = run(
+            """
+            import time
+
+            NOW = time.time()
+
+            def f(a, b):
+                return a - b
+            """,
+            "ZNC009",
+        )
+        assert fs == []
+
+    def test_pragma_exempts(self):
+        fs = run(
+            """
+            import time
+
+            def age(mtime):
+                # cross-process file age IS an epoch difference
+                return time.time() - mtime  # znicz-check: disable=ZNC009
+            """,
+            "ZNC009",
+        )
+        assert fs == []
+
+
 # -- pragmas -------------------------------------------------------------
 
 

@@ -57,4 +57,5 @@ from znicz_tpu.analysis.rules import (  # noqa: E402,F401
     prng_keys,
     sharding_axes,
     traced_branch,
+    wallclock,
 )

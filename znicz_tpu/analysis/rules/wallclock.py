@@ -1,0 +1,114 @@
+"""ZNC009: ``time.time()`` used for duration measurement.
+
+The codebase standard for elapsed-time arithmetic is
+``time.monotonic()`` / ``time.perf_counter()`` (``utils.profiling``'s
+Stopwatch / StepTimer / PhaseTimer): ``time.time()`` is WALL clock, and
+an NTP step mid-measurement corrupts the delta — negative latencies,
+hour-long "epochs", silently wrong benchmark numbers.  ``time.time()``
+is fine as a *timestamp* (log lines, filenames, absolute scheduling);
+what this rule flags is wall-clock values entering SUBTRACTION — either
+a direct ``time.time() - t0`` (or ``t1 - time.time()``), or a
+subtraction whose both operands are names/attributes assigned from
+``time.time()``.
+
+Legitimate epoch-timestamp differences (e.g. comparing mtimes against
+``time.time()``-derived deadlines across processes) are exempted inline
+with ``# znicz-check: disable=ZNC009`` and a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from znicz_tpu.analysis.rules import Rule, register
+
+
+def _is_wall_call(info, node) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and info.resolved(node.func) == "time.time"
+    )
+
+
+def _target_keys(info, tgt) -> List[str]:
+    """Assignment-target names: ``t0`` for Name targets, the dotted
+    path (``self._t0``) for attributes, flattened through tuples."""
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, ast.Attribute):
+        dotted = info.dotted(tgt)
+        return [dotted] if dotted else []
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in tgt.elts:
+            out.extend(_target_keys(info, elt))
+        return out
+    return []
+
+
+@register
+class WallClockDurationRule(Rule):
+    id = "ZNC009"
+    severity = "warning"
+    title = (
+        "time.time() used for duration arithmetic (use time.monotonic()/"
+        "time.perf_counter() or utils.profiling)"
+    )
+
+    def check(self, info) -> Iterable:
+        # pass 1: names (function-scoped) and attributes (module-wide —
+        # self._t0 is typically set in __init__ and read elsewhere)
+        # assigned from time.time()
+        scoped_names = set()  # (id(enclosing function), name)
+        wall_attrs = set()  # dotted attribute paths
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not _is_wall_call(info, value):
+                continue
+            scope = id(info.enclosing_function(node))
+            for tgt in targets:
+                for key in _target_keys(info, tgt):
+                    if "." in key:
+                        wall_attrs.add(key)
+                    else:
+                        scoped_names.add((scope, key))
+
+        def wallish(node, scope) -> bool:
+            if _is_wall_call(info, node):
+                return True
+            if isinstance(node, ast.Name):
+                return (scope, node.id) in scoped_names
+            if isinstance(node, ast.Attribute):
+                return info.dotted(node) in wall_attrs
+            return False
+
+        # pass 2: subtractions consuming wall-clock values
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+            ):
+                continue
+            scope = id(info.enclosing_function(node))
+            direct = _is_wall_call(info, node.left) or _is_wall_call(
+                info, node.right
+            )
+            derived = wallish(node.left, scope) and wallish(
+                node.right, scope
+            )
+            if direct or derived:
+                yield self.finding(
+                    info,
+                    node,
+                    "wall-clock delta: time.time() jumps under NTP "
+                    "steps; measure durations with time.monotonic()/"
+                    "time.perf_counter() (utils.profiling Stopwatch/"
+                    "StepTimer/PhaseTimer), or pragma-exempt a genuine "
+                    "epoch-timestamp difference",
+                )

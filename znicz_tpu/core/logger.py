@@ -31,13 +31,31 @@ class _ColorFormatter(logging.Formatter):
         return msg
 
 
-def setup_logging(level: int = logging.INFO) -> None:
+def setup_logging(level: int = logging.INFO, *, force: bool = False) -> None:
+    """Install the colored stderr handler on the root logger.
+
+    Idempotent: when logging is already configured — by a prior call OR
+    by any other library/test harness that attached root handlers —
+    the existing handlers are left untouched (clobbering them silently
+    un-configures everyone else).  The requested LEVEL is still
+    applied (a multihost worker asking for INFO must not lose its logs
+    to a default-WARNING root someone else left behind).  ``force=True``
+    is the explicit escape hatch that replaces the handlers too.
+    """
     global _configured
+    root = logging.getLogger()
+    if not force and (_configured or root.handlers):
+        _configured = True  # someone configured logging; respect it
+        if level < root.getEffectiveLevel():
+            # only ever RAISE verbosity: a default-WARNING root must not
+            # eat INFO logs, but a deliberately-DEBUG root (pytest
+            # --log-cli-level, basicConfig) must not be quieted either
+            root.setLevel(level)
+        return
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(
         _ColorFormatter("%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S")
     )
-    root = logging.getLogger()
     root.handlers[:] = [handler]
     root.setLevel(level)
     _configured = True

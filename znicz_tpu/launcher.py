@@ -252,7 +252,9 @@ class Launcher(Logger):
 
 def run_args(argv=None) -> Launcher:
     args = make_parser().parse_args(argv)
-    setup_logging(10 if args.verbose else 20)
+    # the CLI owns its process: force-install so --verbose wins even if
+    # an imported library already touched the root logger
+    setup_logging(10 if args.verbose else 20, force=True)
     if args.device:
         # jax is imported by the package before CLI parsing and deployment
         # sitecustomize hooks may force a platform config, so an explicit

@@ -12,7 +12,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, TypeVar
+
+from znicz_tpu import observability
 
 T = TypeVar("T")
 
@@ -58,11 +61,20 @@ def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
                 except queue.Full:  # znicz-check: disable=ZNC008
                     continue
 
+    # how long the training loop blocked waiting on the loader: the
+    # "is the input pipeline the bottleneck" histogram — near-zero waits
+    # mean the device is the limit; long waits mean the loader is
+    wait = observability.histogram(
+        "znicz_prefetch_wait_seconds",
+        "seconds the consumer blocked waiting for the next minibatch",
+    )
     t = threading.Thread(target=worker, daemon=True)
     t.start()
     try:
         while True:
+            t0 = time.perf_counter()
             item = q.get()
+            wait.observe(time.perf_counter() - t0)
             if item is _SENTINEL:
                 if error:
                     raise error[0]

@@ -1,0 +1,64 @@
+"""Unified telemetry: metrics registry, span tracer, export surfaces.
+
+One process-wide substrate replacing the per-subsystem ledgers that
+had accumulated by PR 2 (engine LatencyStats + compile dict, the
+generate serve-cache counters, StatusWriter's timing dict):
+
+* **Registry** (:mod:`registry`) — labeled counters / gauges /
+  histograms with a fixed bucket ladder; Prometheus text exposition
+  (``/metrics`` on ``python -m znicz_tpu.services.serve``,
+  ``metrics.prom`` beside ``status.json``) and JSON snapshots
+  (``status.json``, bench records).
+* **Tracer** (:mod:`tracing`) — nested host spans emitted as Chrome
+  trace-event JSONL (open in https://ui.perfetto.dev), wrapping
+  ``jax.profiler.TraceAnnotation`` so host spans line up with device
+  captures.
+* **PhaseTimer** (:mod:`phases`) — StepTimer-compatible phase timing
+  that feeds both.
+
+Convenience module-level ``counter``/``gauge``/``histogram`` operate on
+the default registry; see docs/OBSERVABILITY.md for the metric catalog.
+Pure stdlib at import time — jax is only touched lazily by the tracer.
+"""
+
+from znicz_tpu.observability.phases import PhaseTimer  # noqa: F401
+from znicz_tpu.observability.registry import (  # noqa: F401
+    DEFAULT_TIME_BUCKETS,
+    Metric,
+    MetricsRegistry,
+    get_registry,
+    parse_prometheus_text,
+)
+from znicz_tpu.observability.tracing import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    instant,
+    span,
+)
+
+
+def counter(name: str, help: str = "", labelnames=()) -> Metric:
+    """Get-or-create a counter on the default registry."""
+    return get_registry().counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames=()) -> Metric:
+    """Get-or-create a gauge on the default registry."""
+    return get_registry().gauge(name, help, labelnames)
+
+
+def histogram(
+    name: str, help: str = "", labelnames=(), buckets=DEFAULT_TIME_BUCKETS
+) -> Metric:
+    """Get-or-create a histogram on the default registry."""
+    return get_registry().histogram(name, help, labelnames, buckets)
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition of the default registry."""
+    return get_registry().prometheus_text()
+
+
+def snapshot() -> dict:
+    """JSON-able snapshot of the default registry."""
+    return get_registry().snapshot()

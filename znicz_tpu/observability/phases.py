@@ -1,0 +1,95 @@
+"""Per-phase timing that feeds the registry and the span tracer.
+
+:class:`PhaseTimer` is the drop-in successor of
+``utils.profiling.StepTimer`` for production paths: the same
+``with timer.phase(name):`` call sites, but every phase now (1) emits a
+tracer span (Perfetto timeline + jax TraceAnnotation alignment) and
+(2) observes into ONE shared registry histogram labeled by phase —
+so ``/metrics``, ``status.json`` and the bench all read the same
+ledger instead of each keeping their own totals dict.
+
+``summary()`` stays StepTimer-shaped (``{phase: {total_s, count,
+mean_ms}}``) but is computed as a DELTA against a baseline captured at
+construction (or the last ``reset()``): the registry series are
+process-lifetime, while a workflow/engine instance only wants to report
+its own window.  Two instances sharing the metric therefore see their
+own counts as long as they don't run interleaved — the registry itself
+always holds the process-wide truth.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+from znicz_tpu.observability.registry import (
+    DEFAULT_TIME_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+)
+from znicz_tpu.observability.tracing import Tracer, get_tracer
+
+
+class PhaseTimer:
+    """StepTimer-compatible phase ledger backed by a registry histogram."""
+
+    def __init__(
+        self,
+        metric: str = "znicz_phase_seconds",
+        *,
+        help: str = "per-phase wall-clock seconds",
+        span_prefix: str = "",
+        registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[Tracer] = None,
+        buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+    ):
+        self._registry = registry if registry is not None else get_registry()
+        self._hist = self._registry.histogram(
+            metric, help, ("phase",), buckets=buckets
+        )
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self._prefix = span_prefix
+        self._base: Dict[str, Tuple[int, float]] = {}
+        self.reset()
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **span_args) -> Iterator[None]:
+        """Time one phase: span ``<prefix><name>`` + histogram observe.
+        ``span_args`` ride into the trace event (request ids, buckets)."""
+        with self._tracer.span(self._prefix + name, **span_args):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self._hist.labels(phase=name).observe(
+                    time.perf_counter() - t0
+                )
+
+    def _totals(self) -> Dict[str, Tuple[int, float]]:
+        return {
+            key[0]: (child.count, child.sum)
+            for key, child in self._hist.children().items()
+        }
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-phase ``{total_s, count, mean_ms}`` since construction or
+        the last :meth:`reset` — StepTimer-shaped, registry-sourced."""
+        out = {}
+        for name, (count, total) in self._totals().items():
+            base_count, base_total = self._base.get(name, (0, 0.0))
+            n, s = count - base_count, total - base_total
+            if n > 0:
+                out[name] = {
+                    "total_s": s,
+                    "count": n,
+                    "mean_ms": 1000.0 * s / n,
+                }
+        return dict(
+            sorted(out.items(), key=lambda kv: -kv[1]["total_s"])
+        )
+
+    def reset(self) -> None:
+        """Re-baseline this instance's window (the registry keeps the
+        process-lifetime series untouched)."""
+        self._base = self._totals()

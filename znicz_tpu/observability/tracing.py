@@ -1,0 +1,217 @@
+"""Span tracer: nested host-side spans as Chrome trace-event JSONL.
+
+Complements :func:`znicz_tpu.utils.profiling.trace` (the jax profiler's
+device capture): this tracer records the HOST side — admit/decode
+chunks, training phases, loader waits — as Chrome trace events that
+Perfetto (https://ui.perfetto.dev) renders on a timeline.  When jax is
+importable, every span also enters ``jax.profiler.TraceAnnotation``, so
+a simultaneous device capture shows the same span names on the device
+tracks and host spans line up with the XLA executions they dispatched.
+
+Events are complete spans (``"ph": "X"``) with microsecond ``ts``/
+``dur`` relative to :meth:`Tracer.start`, one JSON object per line when
+streaming to a file (Perfetto's JSON importer accepts concatenated
+objects; the array wrapper is optional in the trace-event format).
+Spans are no-ops while the tracer is not recording, so instrumentation
+stays in place permanently at ~zero cost.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import Counter
+from typing import Dict, Iterator, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_UNSET = object()
+
+
+class Tracer:
+    """Nested host-span recorder with Chrome trace-event export.
+
+    Usage::
+
+        tracer = observability.get_tracer()
+        tracer.start(path="/tmp/run.trace.jsonl")  # stream as JSONL
+        with tracer.span("epoch", n=3):
+            with tracer.span("dispatch/train"):
+                ...
+        events = tracer.stop()
+    """
+
+    def __init__(self, *, max_events: int = 1_000_000):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._events: List[dict] = []
+        self._recording = False
+        self._file = None
+        self._t0 = time.perf_counter()
+        self._max_events = max_events
+        self.dropped = 0
+        self._annotation = _UNSET
+
+    @property
+    def recording(self) -> bool:
+        return self._recording
+
+    def start(self, path: Optional[str] = None) -> None:
+        """Begin recording (optionally streaming each event to ``path``
+        as one JSON object per line).  Clears any previous events."""
+        with self._lock:
+            if self._recording:
+                raise RuntimeError("tracer is already recording")
+            self._events = []
+            self.dropped = 0
+            self._t0 = time.perf_counter()
+            self._file = open(path, "w") if path else None
+            self._recording = True
+
+    def stop(self) -> List[dict]:
+        """Stop recording; returns (and keeps) the event list.  When the
+        in-memory buffer overflowed, says so — the streamed JSONL file
+        (if any) is still complete."""
+        with self._lock:
+            self._recording = False
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            if self.dropped:
+                logger.warning(
+                    "tracer buffer dropped %d events past max_events=%d;"
+                    " the streamed JSONL file (if any) is complete",
+                    self.dropped,
+                    self._max_events,
+                )
+            return list(self._events)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def span_counts(self) -> Counter:
+        """Span-name -> completed-span count (the acceptance
+        cross-check: N requests => N ``serve/admit`` spans)."""
+        return Counter(
+            e["name"] for e in self.events() if e.get("ph") == "X"
+        )
+
+    def write_jsonl(self, path: str) -> None:
+        """Dump the buffered events, one JSON object per line."""
+        with self._lock:
+            events = list(self._events)
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev, separators=(",", ":")) + "\n")
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, ev: dict) -> None:
+        with self._lock:
+            if not self._recording:
+                return  # span outlived a stop(): drop, don't corrupt
+            # the file streams EVERY event (disk is the durable record);
+            # only the in-memory buffer is capped
+            if self._file is not None:
+                self._file.write(
+                    json.dumps(ev, separators=(",", ":")) + "\n"
+                )
+            if len(self._events) >= self._max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def _annotation_cls(self):
+        """``jax.profiler.TraceAnnotation`` when jax is importable, else
+        None — resolved once, lazily, so this module stays jax-free for
+        hosts with no accelerator stack."""
+        if self._annotation is _UNSET:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._annotation = TraceAnnotation
+            except Exception:
+                logger.debug(
+                    "jax TraceAnnotation unavailable; host spans only",
+                    exc_info=True,
+                )
+                self._annotation = None
+        return self._annotation
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        """One nested host span; ``args`` land in the event's ``args``.
+
+        Inside a recording window the span also enters
+        ``jax.profiler.TraceAnnotation(name)`` so device traces captured
+        concurrently (``profiling.trace``) carry the same names."""
+        if not self._recording:
+            yield
+            return
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        ann = self._annotation_cls()
+        ctx = ann(name) if ann is not None else contextlib.nullcontext()
+        t0 = time.perf_counter()
+        try:
+            with ctx:
+                yield
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            a: Dict[str, object] = dict(args)
+            if parent is not None:
+                a["parent"] = parent
+            ev = {
+                "name": name,
+                "ph": "X",
+                "cat": "host",
+                "ts": round((t0 - self._t0) * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+            }
+            if a:
+                ev["args"] = a
+            self._emit(ev)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker event (``"ph": "i"``)."""
+        if not self._recording:
+            return
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "t",
+            "cat": "host",
+            "ts": round((time.perf_counter() - self._t0) * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+
+_DEFAULT = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide default tracer every subsystem's spans feed."""
+    return _DEFAULT
+
+
+def span(name: str, **args):
+    return _DEFAULT.span(name, **args)
+
+
+def instant(name: str, **args) -> None:
+    _DEFAULT.instant(name, **args)

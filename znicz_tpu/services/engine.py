@@ -17,8 +17,15 @@ stream:
   scatter), so rows at different depths decode together and no prompt
   length or admission pattern ever recompiles it.
 
-Per-request latency and tokens/s ride :mod:`znicz_tpu.utils.profiling`
-(a Stopwatch per request, a LatencyStats aggregate, StepTimer phases);
+Telemetry rides :mod:`znicz_tpu.observability`: admissions, retirements
+(by reason), generated tokens and per-(kind, bucket) compiles are
+registry counters; queue depth and active slots are gauges; per-request
+latency and time-to-first-token are histograms — all visible on
+``/metrics`` and in ``status.json``.  Per-instance views stay available
+(``latency`` is a bounded :class:`~znicz_tpu.utils.profiling.LatencyStats`
+window feeding the shared latency histogram; ``timer`` is a
+:class:`~znicz_tpu.observability.PhaseTimer` whose admit/decode phases
+also emit tracer spans — one ``serve/admit`` span per request), and
 compile counts are introspectable via
 :meth:`DecodeEngine.compile_stats`.
 """
@@ -34,10 +41,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from znicz_tpu import observability
 from znicz_tpu.utils import profiling
 from znicz_tpu.workflow.generate import (
     DEFAULT_PROMPT_BUCKETS,
     _check_sampling_args,
+    _params_fingerprint,
     _sample,
     bucket_for,
     decode_step,
@@ -45,6 +54,14 @@ from znicz_tpu.workflow.generate import (
     pack_prompts,
     prefill,
 )
+
+# process-wide first-compile ledger backing znicz_serve_compiles_total:
+# the jit caches are shared across engines, so a second engine with the
+# same (params geometry, program key) compiles NOTHING new and must not
+# re-increment the counter.  (jax.clear_caches() invalidates this — the
+# counter then under-reports the recompiles; acceptable for a process-
+# lifetime first-compile metric.)
+_COMPILED_KEYS: set = set()
 
 
 @dataclasses.dataclass
@@ -244,6 +261,7 @@ class DecodeEngine:
             params, temperature, top_k, top_p, rng, eos_id
         )
         self.params = params
+        self._params_fp = _params_fingerprint(params)
         self.n_heads = n_heads
         self.eos_id = int(eos_id)
         self.pad_id = int(pad_id if pad_id is not None else eos_id)
@@ -270,8 +288,56 @@ class DecodeEngine:
         self._queue: Deque[Request] = deque()
         self._order: List[Completion] = []
         self.completions: Dict[int, Completion] = {}
-        self.latency = profiling.LatencyStats()
-        self.timer = profiling.StepTimer()
+        # process-wide registry series (shared across engines: get-or-
+        # create); per-instance windows ride LatencyStats / PhaseTimer
+        self._m_submitted = observability.counter(
+            "znicz_serve_requests_submitted_total",
+            "requests accepted into the engine queue",
+        )
+        self._m_admitted = observability.counter(
+            "znicz_serve_requests_admitted_total",
+            "requests prefilled into a batch slot",
+        )
+        self._m_retired = observability.counter(
+            "znicz_serve_requests_retired_total",
+            "completed requests by finish reason",
+            ("reason",),
+        )
+        self._m_tokens = observability.counter(
+            "znicz_serve_tokens_generated_total",
+            "generated tokens across all retired requests",
+        )
+        self._m_compiles = observability.counter(
+            "znicz_serve_compiles_total",
+            "distinct compiled engine programs by kind and bucket",
+            ("kind", "bucket"),
+        )
+        self._m_program_hits = observability.counter(
+            "znicz_serve_program_hits_total",
+            "program invocations served from an already-compiled entry",
+        )
+        self._m_queue_depth = observability.gauge(
+            "znicz_serve_queue_depth", "requests waiting for a slot"
+        )
+        self._m_active = observability.gauge(
+            "znicz_serve_active_slots", "batch slots decoding right now"
+        )
+        self._m_latency = observability.histogram(
+            "znicz_serve_request_latency_seconds",
+            "submit -> retirement latency per request (queue wait included)",
+        )
+        self._m_ttft = observability.histogram(
+            "znicz_serve_ttft_seconds",
+            "submit -> first sampled token per request",
+        )
+        self.latency = profiling.LatencyStats(
+            observe=self._m_latency.observe
+        )
+        self.timer = observability.PhaseTimer(
+            "znicz_serve_phase_seconds",
+            help="engine admit/decode host phase seconds",
+            span_prefix="serve/",
+        )
         self._programs: Dict[tuple, int] = {}
         self._program_hits = 0
         self._next_id = 0
@@ -302,6 +368,8 @@ class DecodeEngine:
             Request(rid, p, int(max_new_tokens), bucket,
                     profiling.Stopwatch())
         )
+        self._m_submitted.inc()
+        self._m_queue_depth.set(len(self._queue))
         return rid
 
     @property
@@ -329,11 +397,21 @@ class DecodeEngine:
 
     def _program(self, key: tuple) -> None:
         """Ledger one executable per key: the compile-count hook's
-        ground truth (tests cross-check it against the jit cache)."""
+        ground truth (tests cross-check it against the jit cache).
+        Registry mirror: ``znicz_serve_compiles_total{kind,bucket}``
+        counts TRUE first compiles per (params geometry, key) across the
+        whole process — a second engine with the same geometry rides the
+        shared jit caches and adds nothing.  ``key[1]`` is the prompt
+        bucket for admits, the chunk size for the decode program."""
         if key in self._programs:
             self._program_hits += 1
+            self._m_program_hits.inc()
         else:
             self._programs[key] = 1
+            full_key = (self._params_fp, key)
+            if full_key not in _COMPILED_KEYS:
+                _COMPILED_KEYS.add(full_key)
+                self._m_compiles.labels(kind=key[0], bucket=key[1]).inc()
 
     def _admit_pending(self) -> None:
         for slot in range(self.batch_size):
@@ -343,9 +421,11 @@ class DecodeEngine:
             # whole decode chunk
             while self._queue and self._slots[slot] is None:
                 self._admit_into(slot, self._queue.popleft())
+        self._m_queue_depth.set(len(self._queue))
+        self._m_active.set(self.active)
 
     def _admit_into(self, slot: int, req: Request) -> None:
-        with self.timer.phase("admit"):
+        with self.timer.phase("admit", request=req.id, bucket=req.bucket):
             tokens, start = pack_prompts(
                 [req.prompt], req.bucket, self.pad_id
             )
@@ -361,6 +441,8 @@ class DecodeEngine:
                 moe_dispatch=self.moe_dispatch,
             )
             first = int(first)
+        self._m_admitted.inc()
+        self._m_ttft.observe(req.watch.elapsed())
         if first == self.eos_id:
             self._retire(req, [first], "eos")
         elif req.max_new_tokens == 1:
@@ -374,7 +456,7 @@ class DecodeEngine:
             self._remaining[slot] = req.max_new_tokens - 1
 
     def _run_chunk(self) -> None:
-        with self.timer.phase("decode"):
+        with self.timer.phase("decode", active=self.active):
             rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
             self._chunk_idx += 1
             greedy, top_k, nucleus = self._structure
@@ -422,6 +504,7 @@ class DecodeEngine:
                 self._slots[slot] = None
                 self._done[slot] = True
                 self._remaining[slot] = 0
+        self._m_active.set(self.active)
 
     def _retire(self, req: Request, emitted: List[int], reason: str):
         dt = req.watch.elapsed()
@@ -438,8 +521,11 @@ class DecodeEngine:
         )
         self._order.append(comp)
         self.completions[req.id] = comp
+        # feeds the shared registry histogram via the observe hook
         self.latency.record(dt)
         self._total_new += len(emitted)
+        self._m_retired.labels(reason=reason).inc()
+        self._m_tokens.inc(len(emitted))
 
     # -- introspection ----------------------------------------------------
 

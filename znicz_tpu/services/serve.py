@@ -1,18 +1,113 @@
 """``python -m znicz_tpu.services.serve <dir> [port]`` — serve a status
-directory over HTTP.
+directory over HTTP, with a Prometheus ``/metrics`` endpoint.
 
 The reference runs a live tornado dashboard inside the training process
 (``veles/web_status.py``, SURVEY.md 2.1); here serving is decoupled: training
-writes ``status.json``/``status.html`` files (StatusWriter) and this command
-— or any web server — exposes them.  Any number of viewers, zero
-training-side state.
+writes ``status.json``/``status.html``/``metrics.prom`` files (StatusWriter)
+and this command — or any web server — exposes them.  Any number of viewers,
+zero training-side state.
+
+Endpoints beyond the static files:
+
+* ``/metrics`` — Prometheus text exposition.  Prefers the
+  ``metrics.prom`` the training process drops into the status directory
+  (textfile-collector pattern: the scrape reflects the TRAINING
+  process's registry); falls back to this server process's own registry
+  when the file is absent (e.g. an in-process DecodeEngine server).
+* ``/metrics.json`` — the same data as a JSON snapshot, with the same
+  file-first preference (the ``"metrics"`` snapshot StatusWriter embeds
+  in ``status.json``), so the two endpoints never contradict each
+  other.
 """
 
 from __future__ import annotations
 
 import functools
 import http.server
+import json
+import logging
+import os
 import sys
+
+from znicz_tpu.observability import get_registry, parse_prometheus_text
+
+logger = logging.getLogger(__name__)
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _snapshot_from_prom(text: str) -> dict:
+    """Sample-level JSON view of a Prometheus exposition: ``{sample_name:
+    {"type"?: ..., "series": [{"labels": ..., "value": ...}]}}``.
+    Histogram families appear as their raw ``_bucket``/``_sum``/
+    ``_count`` sample names — a faithful rendering of the file, used
+    when ``status.json`` carries no embedded snapshot."""
+    parsed = parse_prometheus_text(text)
+    out: dict = {}
+    for name, labels, value in parsed["samples"]:
+        fam = out.setdefault(name, {"series": []})
+        fam["series"].append({"labels": labels, "value": value})
+    for name, kind in parsed["types"].items():
+        if name in out:
+            out[name]["type"] = kind
+    return out
+
+
+class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
+    """Static status files + the registry export endpoints."""
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            prom = os.path.join(self.directory, "metrics.prom")
+            if os.path.exists(prom):
+                with open(prom, "rb") as f:
+                    body = f.read()
+            else:
+                body = get_registry().prometheus_text().encode()
+            self._send(body, PROM_CONTENT_TYPE)
+        elif path == "/metrics.json":
+            snap = self._training_snapshot()
+            if snap is None:
+                snap = get_registry().snapshot()
+            body = json.dumps(snap, indent=2).encode()
+            self._send(body, "application/json")
+        else:
+            super().do_GET()
+
+    def _training_snapshot(self):
+        """The training process's snapshot, or None: the ``"metrics"``
+        dict embedded in ``status.json`` when present, else a sample-
+        level view derived from ``metrics.prom`` — so /metrics.json can
+        never describe a different world than /metrics does (both are
+        training-file-first, live-registry-last)."""
+        status_path = os.path.join(self.directory, "status.json")
+        if os.path.exists(status_path):
+            try:
+                with open(status_path) as f:
+                    snap = json.load(f).get("metrics")
+                if snap is not None:
+                    return snap
+            except (OSError, ValueError):
+                # a half-written legacy file must not 500 the endpoint
+                logger.warning("unreadable %s; trying metrics.prom",
+                               status_path)
+        prom_path = os.path.join(self.directory, "metrics.prom")
+        if os.path.exists(prom_path):
+            try:
+                with open(prom_path) as f:
+                    return _snapshot_from_prom(f.read())
+            except (OSError, ValueError):
+                logger.warning("unreadable %s; serving live registry",
+                               prom_path)
+        return None
+
+    def _send(self, body: bytes, content_type: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
 
 def main(argv=None) -> int:
@@ -22,10 +117,11 @@ def main(argv=None) -> int:
     directory = args[0] if args else "."
     port = int(args[1]) if len(args) > 1 else 8080
     host = args[2] if len(args) > 2 else "127.0.0.1"
-    handler = functools.partial(
-        http.server.SimpleHTTPRequestHandler, directory=directory
+    handler = functools.partial(StatusRequestHandler, directory=directory)
+    print(
+        f"serving {directory} at http://{host}:{port}/status.html "
+        f"(metrics at /metrics)"
     )
-    print(f"serving {directory} at http://{host}:{port}/status.html")
     http.server.ThreadingHTTPServer((host, port), handler).serve_forever()
     return 0
 

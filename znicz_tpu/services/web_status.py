@@ -21,9 +21,21 @@ import json
 import logging
 import os
 
+from znicz_tpu.observability import get_registry
 from znicz_tpu.utils.profiling import Stopwatch
 
 logger = logging.getLogger(__name__)
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """Write-then-rename so a concurrently-polling reader (the serve
+    process, a dashboard scraper) can never observe a truncated file.
+    The temp file lives in the same directory, so ``os.replace`` is an
+    atomic same-filesystem rename."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
 
 
 class StatusWriter:
@@ -48,15 +60,28 @@ class StatusWriter:
             "summary": verdict["summary"],
             "history_len": len(dec.history),
             # per-phase wall-clock ledger (reference per-unit timing on the
-            # status page, SURVEY.md 5.1)
+            # status page, SURVEY.md 5.1) — a windowed view over the same
+            # registry histogram the full snapshot below exports
             "timing": (
                 workflow.timer.summary()
                 if getattr(workflow, "timer", None)
                 else {}
             ),
+            # the whole process-wide metrics registry, embedded so one
+            # status.json answers "what is this process doing right now"
+            "metrics": get_registry().snapshot(),
         }
-        with open(os.path.join(self.directory, "status.json"), "w") as f:
-            json.dump(status, f, indent=2)
+        _atomic_write(
+            os.path.join(self.directory, "status.json"),
+            json.dumps(status, indent=2),
+        )
+        # Prometheus text beside the JSON: the serve process's /metrics
+        # endpoint prefers this file (textfile-collector pattern), so a
+        # scraper sees the TRAINING process's registry, not the server's
+        _atomic_write(
+            os.path.join(self.directory, "metrics.prom"),
+            get_registry().prometheus_text(),
+        )
         self._write_html(status)
 
     @staticmethod
@@ -121,6 +146,8 @@ best {status['best_value']} @ {status['best_epoch']} —
     f'alt="{html.escape(name)}" style="max-width:45em"></p>'
     for name, mtime in self._plot_images()
 )}
+<details><summary>metrics registry snapshot</summary>
+<pre>{html.escape(json.dumps(status.get("metrics", {}), indent=2))}</pre>
+</details>
 </body></html>"""
-        with open(os.path.join(self.directory, "status.html"), "w") as f:
-            f.write(doc)
+        _atomic_write(os.path.join(self.directory, "status.html"), doc)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Iterator, List
+from typing import Callable, Dict, Iterator, List, Optional
 
 
 @contextlib.contextmanager
@@ -57,16 +57,43 @@ class LatencyStats:
     The serving engine (services/engine.py) records one sample per
     retired request; the summary is what the serve bench and status
     surfaces report.  Plain Python like the rest of this module — no
-    numpy dependency for a handful of floats."""
+    numpy dependency for a handful of floats.
 
-    def __init__(self):
+    Memory is BOUNDED: a ring buffer keeps the most recent
+    ``max_samples`` observations (a long-lived engine must not grow a
+    list forever), so percentiles/mean describe that sliding window
+    while ``count`` stays the lifetime total.  ``observe`` (when given)
+    is called once per recorded sample — the hook the engine uses to
+    feed the shared metrics-registry histogram without keeping a second
+    ledger beside it."""
+
+    def __init__(
+        self,
+        max_samples: int = 4096,
+        observe: Optional[Callable[[float], None]] = None,
+    ):
+        if max_samples < 1:
+            raise ValueError(f"want max_samples >= 1; got {max_samples}")
+        self._cap = int(max_samples)
+        self._observe = observe
         self._samples: List[float] = []
+        self._next = 0  # ring write cursor once the buffer is full
+        self._count = 0
 
     def record(self, seconds: float) -> None:
-        self._samples.append(float(seconds))
+        v = float(seconds)
+        if self._observe is not None:
+            self._observe(v)
+        if len(self._samples) < self._cap:
+            self._samples.append(v)
+        else:
+            self._samples[self._next] = v
+            self._next = (self._next + 1) % self._cap
+        self._count += 1
 
     def __len__(self) -> int:
-        return len(self._samples)
+        """Lifetime sample count (not the retained-window size)."""
+        return self._count
 
     def summary(self) -> Dict[str, float]:
         if not self._samples:
@@ -77,15 +104,18 @@ class LatencyStats:
             return s[min(len(s) - 1, int(round(p * (len(s) - 1))))]
 
         return {
-            "count": len(s),
+            "count": self._count,
             "mean_ms": 1000.0 * sum(s) / len(s),
             "p50_ms": 1000.0 * pct(0.5),
             "p95_ms": 1000.0 * pct(0.95),
+            "p99_ms": 1000.0 * pct(0.99),
             "max_ms": 1000.0 * s[-1],
         }
 
     def reset(self) -> None:
         self._samples.clear()
+        self._next = 0
+        self._count = 0
 
 
 class StepTimer:

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from znicz_tpu import observability
 from znicz_tpu.ops.normalization import layer_norm
 from znicz_tpu.workflow.transformer import _block_ffn
 
@@ -406,17 +407,53 @@ class _ServeCache:
     ``jax.jit`` already memoizes by (shapes, statics); this layer makes
     the serving contract INSPECTABLE: every distinct key is one real
     AOT-compiled executable (``lower().compile()``), so ``programs`` is
-    an exact compile count, not an inference from timing."""
+    an exact compile count, not an inference from timing.  The
+    request/hit/compile tallies live in the process-wide metrics
+    registry (``znicz_serve_cache_*_total`` — visible on ``/metrics``
+    and in ``status.json``); the attributes here are read-through
+    views, not a second ledger."""
 
     def __init__(self):
         self.programs = {}  # key -> compiled executable
-        self.hits = 0
-        self.requests = 0
+        self._requests = observability.counter(
+            "znicz_serve_cache_requests_total",
+            "generate_serve() invocations",
+        )
+        self._hits = observability.counter(
+            "znicz_serve_cache_hits_total",
+            "generate_serve() calls served without compiling",
+        )
+        self._compiles = observability.counter(
+            "znicz_serve_cache_compiles_total",
+            "generate_serve() AOT compiles (distinct executable keys)",
+        )
+
+    @property
+    def hits(self) -> int:
+        return int(self._hits.value)
+
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def compiles(self) -> int:
+        return int(self._compiles.value)
+
+    def record_request(self) -> None:
+        self._requests.inc()
+
+    def record_hit(self) -> None:
+        self._hits.inc()
+
+    def record_compile(self) -> None:
+        self._compiles.inc()
 
     def reset(self):
         self.programs.clear()
-        self.hits = 0
-        self.requests = 0
+        self._requests.reset()
+        self._hits.reset()
+        self._compiles.reset()
 
 
 _serve_cache = _ServeCache()
@@ -430,6 +467,7 @@ def serve_cache_stats() -> dict:
         "programs": len(_serve_cache.programs),
         "hits": _serve_cache.hits,
         "requests": _serve_cache.requests,
+        "compiles": _serve_cache.compiles,
         "keys": sorted(
             str(k[:-1]) for k in _serve_cache.programs
         ),  # drop the params fingerprint — noise for humans
@@ -516,7 +554,7 @@ def generate_serve(
     )
     temperature = jnp.float32(temperature)
     top_p = jnp.float32(top_p)
-    _serve_cache.requests += 1
+    _serve_cache.record_request()
     # the rung sizes the compiled buffers; the REQUESTED budget rides in
     # as a traced operand, so the loop never decodes past the request
     budget = jnp.int32(max_new_tokens)
@@ -529,7 +567,8 @@ def generate_serve(
             moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
         ).compile()
         _serve_cache.programs[key] = compiled
+        _serve_cache.record_compile()
     else:
-        _serve_cache.hits += 1
+        _serve_cache.record_hit()
     out = compiled(params, padded, start, budget, temperature, top_p, rng)
     return out[:, pad: pad + tp + max_new_tokens]

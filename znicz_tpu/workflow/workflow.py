@@ -25,7 +25,8 @@ from znicz_tpu.loader.base import TRAIN, Loader
 from znicz_tpu.nn import evaluator, optimizer
 from znicz_tpu.nn.decision import Decision
 from znicz_tpu.nn.train_state import TrainState
-from znicz_tpu.utils.profiling import StepTimer, Stopwatch
+from znicz_tpu.observability import PhaseTimer
+from znicz_tpu.utils.profiling import Stopwatch
 from znicz_tpu.workflow.model import Model
 from znicz_tpu.workflow.snapshotter import Snapshotter
 
@@ -127,7 +128,15 @@ class Workflow(Logger):
         self._eval_conf_step = None
         self._ctx = None
         self._host_step = 0
-        self.timer = StepTimer()  # per-phase ledger (SURVEY.md 5.1)
+        # per-phase ledger (SURVEY.md 5.1), re-founded on the telemetry
+        # substrate: every phase is a tracer span AND an observation into
+        # the registry's znicz_train_phase_seconds histogram — status
+        # page, /metrics and bench all read the same series
+        self.timer = PhaseTimer(
+            "znicz_train_phase_seconds",
+            help="training host phase seconds (dispatch, stack, sync)",
+            span_prefix="train/",
+        )
 
     # ------------------------------------------------------------------
     def _metrics(self, out, y, mask):
