@@ -690,6 +690,87 @@ def _bench() -> None:
         file=sys.stderr,
     )
 
+    # ---- PAGED serving (ISSUE 4): the same mixed stream through the
+    # block-pool engine, pool sized to the dense engine's EXACT KV
+    # footprint (B slots x t_max tokens) so tokens/s is an apples-to-
+    # apples layout comparison, plus a max-sustained-concurrency probe:
+    # 2x the slots against that same pool with short requests — the
+    # dense layout caps at B rows in this memory; the paged pool packs
+    # them by blocks actually used (peak_active is the measured answer,
+    # preemptions how often pressure forced an eviction).
+    # block 32: at the mid config the fatter prefill chunk/window halves
+    # host dispatches for the same pool memory (32-multiple padding on
+    # this stream matches the dense bucket ladder's anyway)
+    LM_SERVE_PAGED_BLOCK = 32
+
+    def lm_serve_paged_stats(cfg, b):
+        from znicz_tpu.services.engine import PagedDecodeEngine
+        from znicz_tpu.workflow.transformer import init_lm_params
+
+        prng.seed_all(95)
+        params = init_lm_params(
+            cfg["vocab"], cfg["d_model"], cfg["n_layers"], cfg["n_heads"],
+            max_seq=256,
+        )
+        reqs = np.random.default_rng(12)
+        block = LM_SERVE_PAGED_BLOCK
+        n_blocks = b * (256 // block) + 1  # dense footprint + null block
+
+        def make_engine(slots):
+            return PagedDecodeEngine(
+                params, n_heads=cfg["n_heads"], eos_id=0,
+                batch_size=slots, admit_every=8, max_seq=256,
+                block_size=block, n_blocks=n_blocks,
+            )
+
+        def stream(eng, n):
+            for j in range(n):
+                length = LM_SERVE_LENS[j % len(LM_SERVE_LENS)]
+                eng.submit(
+                    reqs.integers(1, cfg["vocab"], (length,)).astype(
+                        np.int32
+                    ),
+                    max_new_tokens=LM_SERVE_NEW,
+                )
+            return eng.run()
+
+        stream(make_engine(b), len(LM_SERVE_LENS))  # warm both programs
+        eng = make_engine(b)  # fresh engine rides the warm jit cache
+        t0 = time.time()
+        comps = stream(eng, 4 * b)
+        wall = time.time() - t0
+        toks = sum(c.n_new for c in comps)
+        # concurrency probe: short requests (16-token prompts, 16-token
+        # budgets = 2 blocks each) through 2x slots over the same pool
+        probe = make_engine(2 * b)
+        for _ in range(4 * b):
+            probe.submit(
+                reqs.integers(1, cfg["vocab"], (16,)).astype(np.int32),
+                max_new_tokens=16,
+            )
+        probe.run()
+        return toks / wall, eng.stats(), probe.stats()
+
+    try:
+        lm_serve_paged, lm_paged_st, lm_paged_probe = lm_serve_paged_stats(
+            LM_MID, LM_MID_B
+        )
+    except Exception as e:
+        print(f"lm serve paged failed: {type(e).__name__}", file=sys.stderr)
+        lm_serve_paged, lm_paged_st, lm_paged_probe = 0.0, {}, {}
+    finally:
+        jax.clear_caches()
+        gc.collect()
+    print(
+        f"LM serving PAGED (block {LM_SERVE_PAGED_BLOCK}, mixed prompts "
+        f"{LM_SERVE_LENS}): {lm_serve_paged:.0f} tok/s "
+        f"({lm_paged_st.get('n_programs', 0)} programs, "
+        f"{lm_paged_st.get('preemptions', 0)} preemptions); "
+        f"concurrency probe peak {lm_paged_probe.get('peak_active', 0)} "
+        f"rows (dense layout caps at {LM_MID_B} in the same memory)",
+        file=sys.stderr,
+    )
+
     # long context: flash (O(T*D) memory) + remat train the mid model at
     # 8x the headline sequence length on ONE chip — dense attention OOMs
     # at T=2048 already.  T=16384, B=2 (32k tokens/step, same as mid).
@@ -841,6 +922,30 @@ def _bench() -> None:
                 "lm_serve_latency_ms": {
                     k: round(v, 1)
                     for k, v in lm_serve_st.get("latency", {}).items()
+                },
+                "lm_serve_paged_config": (
+                    f"mid config paged engine: B={LM_MID_B} slots, "
+                    f"block {LM_SERVE_PAGED_BLOCK}, pool == dense "
+                    f"footprint ({LM_MID_B}x256 tokens), mixed prompts "
+                    f"{LM_SERVE_LENS}, budget {LM_SERVE_NEW}; probe: "
+                    f"2x slots, 16+16-token requests, same pool"
+                ),
+                "lm_serve_paged_tokens_per_sec": round(lm_serve_paged, 1),
+                "lm_serve_paged_vs_dense": round(
+                    lm_serve_paged / lm_serve if lm_serve else 0.0, 4
+                ),
+                "lm_serve_paged_compiles": lm_paged_st.get(
+                    "n_programs", 0
+                ),
+                "lm_serve_paged_preemptions": lm_paged_st.get(
+                    "preemptions", 0
+                ),
+                "lm_serve_paged_max_concurrency": lm_paged_probe.get(
+                    "peak_active", 0
+                ),
+                "lm_serve_paged_latency_ms": {
+                    k: round(v, 1)
+                    for k, v in lm_paged_st.get("latency", {}).items()
                 },
                 "lm_long_context": (
                     f"mid config at T={LM_LONG_T}, B={LM_LONG_B}, "

@@ -40,6 +40,145 @@ class TestDotProductAttention:
         assert y.shape == (2, 10, 32)
 
 
+class TestPagedAttention:
+    """Block-table attention (docs/SERVING.md paged KV): gathered-window
+    numerics must equal a dense masked softmax over the same keys,
+    whatever (shuffled) block assignment the table holds."""
+
+    def _paged_setup(self, b=2, t=32, h=2, d=8, bs=8, seed=0):
+        rng = np.random.default_rng(seed)
+        m = t // bs
+        k = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        v = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        # scatter each row's contiguous K/V into a shared pool under a
+        # SHUFFLED block assignment (block 0 reserved null, as served)
+        n_blocks = 1 + b * m
+        table = (
+            rng.permutation(np.arange(1, n_blocks))
+            .reshape(b, m)
+            .astype(np.int32)
+        )
+        k_pool = np.zeros((n_blocks, bs, h, d), np.float32)
+        v_pool = np.zeros((n_blocks, bs, h, d), np.float32)
+        for row in range(b):
+            for j in range(m):
+                k_pool[table[row, j]] = k[row, j * bs:(j + 1) * bs]
+                v_pool[table[row, j]] = v[row, j * bs:(j + 1) * bs]
+        return k, v, k_pool, v_pool, table
+
+    @staticmethod
+    def _dense_ref(q, k, v, q_pos, start):
+        """Masked stable softmax per row, numpy — the paged contract."""
+        b, tq, h, d = q.shape
+        out = np.zeros_like(q)
+        for row in range(b):
+            for qi in range(tq):
+                p = q_pos[row, qi]
+                lo = min(start[row], p)
+                s = np.einsum(
+                    "hd,khd->hk", q[row, qi], k[row]
+                ) / np.sqrt(d)
+                mask = np.zeros(k.shape[1], bool)
+                mask[lo: p + 1] = True
+                s = np.where(mask[None, :], s, -np.inf)
+                e = np.exp(s - s.max(axis=-1, keepdims=True))
+                w = e / e.sum(axis=-1, keepdims=True)
+                out[row, qi] = np.einsum("hk,khd->hd", w, v[row])
+        return out
+
+    def test_decode_step_matches_dense_masked_softmax(self):
+        bs = 8
+        k, v, k_pool, v_pool, table = self._paged_setup(bs=bs)
+        rng = np.random.default_rng(1)
+        q = rng.normal(size=(2, 1, 2, 8)).astype(np.float32)
+        pos = np.asarray([[13], [29]], np.int32)
+        start = np.asarray([3, 0], np.int32)
+        out = attention.paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(pos), block_size=bs,
+            start=jnp.asarray(start),
+        )
+        ref = self._dense_ref(q, k, v, pos, start)
+        np.testing.assert_allclose(
+            np.asarray(out), ref, rtol=2e-5, atol=2e-6
+        )
+
+    def test_prefill_chunk_queries_match(self):
+        # a whole chunk of queries at consecutive positions (the
+        # chunked-prefill shape), pad-region queries included: their
+        # window collapses to the self position and stays finite
+        bs = 8
+        k, v, k_pool, v_pool, table = self._paged_setup(bs=bs)
+        rng = np.random.default_rng(2)
+        q = rng.normal(size=(2, bs, 2, 8)).astype(np.float32)
+        q_pos = np.broadcast_to(np.arange(bs), (2, bs)).astype(np.int32)
+        start = np.asarray([5, 0], np.int32)  # row 0: pad queries 0..4
+        out = attention.paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(q_pos), block_size=bs,
+            start=jnp.asarray(start),
+        )
+        ref = self._dense_ref(q, k, v, q_pos, start)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(
+            np.asarray(out), ref, rtol=2e-5, atol=2e-6
+        )
+
+    def test_stale_blocks_cannot_leak(self):
+        # poison every pool block the tables do NOT cover a row's valid
+        # window with: garbage past pos / outside the table must not
+        # change the output (masking is by index, never by value)
+        bs = 8
+        k, v, k_pool, v_pool, table = self._paged_setup(bs=bs)
+        rng = np.random.default_rng(3)
+        q = rng.normal(size=(2, 1, 2, 8)).astype(np.float32)
+        pos = np.asarray([[10], [3]], np.int32)
+        start = np.asarray([2, 0], np.int32)
+        clean = attention.paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(pos), block_size=bs,
+            start=jnp.asarray(start),
+        )
+        kp, vp = k_pool.copy(), v_pool.copy()
+        for row in range(2):
+            p = int(pos[row, 0])
+            jb, slot = p // bs, p % bs
+            kp[table[row, jb], slot + 1:] = 1e9  # rest of the live block
+            vp[table[row, jb], slot + 1:] = 1e9
+            for j in range(jb + 1, table.shape[1]):  # blocks past pos
+                kp[table[row, j]] = 1e9
+                vp[table[row, j]] = 1e9
+        kp[0] = 1e9  # the null block
+        vp[0] = 1e9
+        poisoned = attention.paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(table), jnp.asarray(pos), block_size=bs,
+            start=jnp.asarray(start),
+        )
+        np.testing.assert_allclose(
+            np.asarray(clean), np.asarray(poisoned), rtol=1e-6
+        )
+
+    def test_pallas_stub_delegates_to_reference(self):
+        from znicz_tpu.ops.pallas import paged_attention as pp
+
+        bs = 8
+        _, _, k_pool, v_pool, table = self._paged_setup(bs=bs)
+        rng = np.random.default_rng(4)
+        q = rng.normal(size=(2, 1, 2, 8)).astype(np.float32)
+        pos = np.asarray([[9], [17]], np.int32)
+        ref = attention.paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(pos), block_size=bs,
+        )
+        out = pp.paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(pos), block_size=bs,
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+        assert pp.PALLAS_PAGED_IMPLEMENTED is False
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_single_device(self, causal):

@@ -259,6 +259,58 @@ class TestTracer:
 # -- phase timer -----------------------------------------------------------
 
 
+class TestPipelineSpans:
+    """ROADMAP observability next-rung: snapshot writes and the loader
+    prefetch producer thread must appear on the Perfetto timeline."""
+
+    def test_snapshot_and_prefetch_producer_spans(self, tmp_path):
+        from znicz_tpu.loader.prefetch import prefetch
+        from znicz_tpu.workflow.snapshotter import Snapshotter
+
+        tr = obs.get_tracer()
+        tr.start()
+        try:
+            snap = Snapshotter(str(tmp_path), compress=False)
+            snap.save(
+                {"w": np.zeros((2, 2), np.float32)}, {"epoch": 1},
+                tag="best",
+            )
+            out = list(prefetch(iter(range(5)), depth=2))
+        finally:
+            events = tr.stop()
+        assert out == list(range(5))
+        counts = Counter(
+            e["name"] for e in events if e.get("ph") == "X"
+        )
+        assert counts["snapshot/save"] == 1
+        assert counts["snapshot/gather"] == 1
+        assert counts["snapshot/write"] == 1
+        # one produce span per item + the final end-of-stream pull
+        assert counts["loader/prefetch_produce"] == 6
+        # gather/write nest inside the save span
+        write = next(e for e in events if e["name"] == "snapshot/write")
+        assert write["args"]["parent"] == "snapshot/save"
+        # producer spans carry the WORKER thread's tid — their own
+        # Perfetto track, next to (not under) the consumer's spans
+        prod = [
+            e for e in events if e["name"] == "loader/prefetch_produce"
+        ]
+        assert all(e["tid"] != threading.get_ident() for e in prod)
+
+    def test_snapshot_save_untraced_still_writes(self, tmp_path):
+        # spans must be pure observation: with the tracer idle the save
+        # path writes the same file
+        from znicz_tpu.workflow.snapshotter import Snapshotter, load_snapshot
+
+        snap = Snapshotter(str(tmp_path), compress=False)
+        path = snap.save(
+            {"w": np.ones((2,), np.float32)}, {"epoch": 2}, tag="best"
+        )
+        state, host = load_snapshot(path)
+        np.testing.assert_array_equal(state["w"], np.ones((2,)))
+        assert host == {"epoch": 2}
+
+
 class TestPhaseTimer:
     def test_summary_is_windowed_over_shared_series(self):
         r = MetricsRegistry()

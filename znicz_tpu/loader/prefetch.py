@@ -35,8 +35,20 @@ def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
     error: list = []
 
     def worker():
+        # producer-side spans (ROADMAP observability next-rung): each
+        # span is the time the LOADER spent materializing one batch —
+        # on its own thread track in Perfetto, so loader stalls line up
+        # against the consumer's znicz_prefetch_wait_seconds histogram
+        # and the train/serve spans they starve.  No-op cost when the
+        # tracer is idle.
+        tracer = observability.get_tracer()
         try:
-            for item in iterable:
+            it = iter(iterable)
+            while True:
+                with tracer.span("loader/prefetch_produce"):
+                    item = next(it, _SENTINEL)
+                if item is _SENTINEL:
+                    break
                 # bounded put that gives up when the consumer went away
                 while not stop.is_set():
                     try:

@@ -46,6 +46,64 @@ def dot_product_attention(
     return out.astype(q.dtype)
 
 
+def paged_attention(
+    q: jnp.ndarray,  # [B, Tq, H, D]
+    k_pool: jnp.ndarray,  # [N_blocks, block_size, H, D]
+    v_pool: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, M] int32 pool block ids
+    q_pos: jnp.ndarray,  # [B, Tq] int32 absolute query positions
+    *,
+    block_size: int,
+    start: Optional[jnp.ndarray] = None,  # [B] first real position
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Block-table attention over paged KV pools; returns [B, Tq, H, D].
+
+    The serving KV layout (vLLM/PagedAttention lineage): K/V live in a
+    shared ``[n_blocks, block_size, H, D]`` pool and each row owns an
+    ordered block table — table entry ``j`` covers absolute positions
+    ``j*block_size .. (j+1)*block_size-1`` of that row.  The row's
+    window is GATHERED from the pool (``k_pool[block_table]``), so the
+    compiled program is shape-static in everything but the traced table
+    values: rows growing into new blocks, block reuse after retirement,
+    and any pool size never recompile.
+
+    Validity is by ABSOLUTE key index, exactly like the dense cache
+    path (:mod:`znicz_tpu.workflow.generate`): key position must be
+    ``<= q_pos`` and (under left-padding) ``>= start``, so unallocated
+    or stale table entries — whose positions fall outside every valid
+    window — are masked out by INDEX, never read through.  A pad-region
+    query keeps its own position so its softmax stays finite (same
+    NaN-poisoning guard as the dense mask).  Numerics mirror
+    :func:`dot_product_attention`: f32 score accumulation, stable
+    softmax, f32 value accumulation.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    b, tq = q.shape[:2]
+    m = block_table.shape[1]
+    # [B, M, bs, H, D] -> [B, M*bs, H, D]: the row-ordered KV window
+    k = k_pool[block_table].reshape(b, m * block_size, *k_pool.shape[2:])
+    v = v_pool[block_table].reshape(b, m * block_size, *v_pool.shape[2:])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    k_idx = jnp.arange(m * block_size)[None, None, None, :]
+    qp = q_pos[:, None, :, None]
+    valid = k_idx <= qp
+    if start is not None:
+        st = start[:, None, None, None]
+        valid = valid & (k_idx >= jnp.minimum(st, qp))
+    s = jnp.where(valid, s, -jnp.inf)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
 def init_mha_params(
     d_model: int,
     n_heads: int,

@@ -16,6 +16,7 @@ from znicz_tpu.services.plotting import (  # noqa: F401
 from znicz_tpu.services.engine import (  # noqa: F401
     Completion,
     DecodeEngine,
+    PagedDecodeEngine,
 )
 from znicz_tpu.services.image_saver import ImageSaver  # noqa: F401
 from znicz_tpu.services.publishing import MarkdownReporter  # noqa: F401

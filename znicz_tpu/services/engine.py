@@ -17,6 +17,17 @@ stream:
   scatter), so rows at different depths decode together and no prompt
   length or admission pattern ever recompiles it.
 
+**Paged backend** (:class:`PagedDecodeEngine`, PAPERS.md vLLM/Sarathi
+lineage): instead of a dense ``[B, T_max]`` reservation per slot, K/V
+live in a shared block pool (``[n_blocks, block_size, H, hd]`` per
+layer) and each slot owns a block table.  Admission allocates blocks
+lazily as decode advances, prompts prefill in block-sized CHUNKS
+interleaved with decode chunks (a long prompt never stalls the batch),
+and when the pool runs dry the engine PREEMPTS the youngest request —
+frees its blocks, requeues it for recompute-on-readmission — instead
+of rejecting.  Concurrency is bounded by memory actually used, not by
+``n_slots * T_max`` worst case; docs/SERVING.md has the tuning table.
+
 Telemetry rides :mod:`znicz_tpu.observability`: admissions, retirements
 (by reason), generated tokens and per-(kind, bucket) compiles are
 registry counters; queue depth and active slots are gauges; per-request
@@ -45,13 +56,17 @@ from znicz_tpu import observability
 from znicz_tpu.utils import profiling
 from znicz_tpu.workflow.generate import (
     DEFAULT_PROMPT_BUCKETS,
+    NULL_BLOCK,
     _check_sampling_args,
     _params_fingerprint,
     _sample,
     bucket_for,
     decode_step,
     init_kv_cache,
+    init_paged_kv,
     pack_prompts,
+    paged_decode_step,
+    paged_prefill_chunk,
     prefill,
 )
 
@@ -211,6 +226,102 @@ def _decode_chunk(
     return caches, tok, pos, done, remaining, out, i
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "block_size", "n_heads", "greedy", "top_k", "nucleus",
+        "moe_top_k", "moe_dispatch",
+    ),
+    donate_argnums=(1,),
+)
+def _paged_prefill_prog(
+    params, pools, table, tokens, offset, start, temperature, top_p,
+    key, *, block_size, n_heads, greedy, top_k, nucleus, moe_top_k,
+    moe_dispatch,
+):
+    """One aligned prompt chunk into the row's blocks + first-token
+    sample.  ONE compiled shape covers every prompt length and every
+    chunk index (``offset``/``table`` are traced operands; the chunk is
+    always ``[1, block_size]``) — chunked prefill's compile story beats
+    the dense path's one-admit-program-per-bucket.  The sample only
+    matters on the final chunk; computing it unconditionally keeps the
+    program single and costs one argmax/categorical per chunk."""
+    pools, logits = paged_prefill_chunk(
+        params, pools, table, tokens, offset, n_heads=n_heads,
+        block_size=block_size, start=start, moe_top_k=moe_top_k,
+        moe_dispatch=moe_dispatch,
+    )
+    first = _sample_tok(
+        logits, key, temperature, top_p, greedy=greedy, top_k=top_k,
+        nucleus=nucleus,
+    )
+    return pools, first[0]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "chunk", "block_size", "t_max", "n_heads", "eos_id", "greedy",
+        "top_k", "nucleus", "moe_top_k", "moe_dispatch",
+    ),
+    donate_argnums=(1,),
+)
+def _paged_decode_chunk(
+    params, pools, tables, tok, pos, start, done, remaining,
+    temperature, top_p, rng, *, chunk, block_size, t_max, n_heads,
+    eos_id, greedy, top_k, nucleus, moe_top_k, moe_dispatch,
+):
+    """Up to ``chunk`` paged decode steps for the whole batch in ONE
+    compiled program (the paged twin of :func:`_decode_chunk`).
+
+    Per-row positions are native to the paged step (the block table is
+    the indirection — no vmap-into-scatter), so no prompt length,
+    admission pattern, block assignment or pool occupancy ever
+    recompiles this.  Done/idle rows write to the reserved null block
+    and their positions FREEZE (a clamped position could walk into a
+    table entry the allocator already handed to another row — the
+    dense chunk's clamp-and-ignore trick is not safe against a shared
+    pool)."""
+    b = tok.shape[0]
+    # clamp against the FULL positional capacity, never the (possibly
+    # narrower) gathered window: the final loop iteration pushes a live
+    # row's pos one past this chunk's allocation, and freezing it at
+    # the window edge would overwrite the edge slot next step.  The
+    # transiently out-of-window pos is harmless — the host re-windows
+    # and re-allocates before the next chunk reads it.
+    t_cap = t_max - 1
+    fill = jnp.int32(eos_id)
+    out = jnp.full((b, chunk), fill, jnp.int32)
+
+    def cond(carry):
+        i, _, _, _, done, _, _ = carry
+        return (i < chunk) & ~jnp.all(done)
+
+    def body(carry):
+        i, pools, tok, pos, done, remaining, out = carry
+        pools, logits = paged_decode_step(
+            params, pools, tables, tok, pos, n_heads=n_heads,
+            block_size=block_size, start=start, write_mask=~done,
+            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+        )
+        nxt = _sample_tok(
+            logits, jax.random.fold_in(rng, i), temperature, top_p,
+            greedy=greedy, top_k=top_k, nucleus=nucleus,
+        )
+        nxt = jnp.where(done, fill, nxt)
+        remaining = jnp.where(done, remaining, remaining - 1)
+        done = done | (nxt == eos_id) | (remaining <= 0)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+        pos = jnp.where(done, pos, jnp.minimum(pos + 1, t_cap))
+        return (i + 1, pools, nxt, pos, done, remaining, out)
+
+    i, pools, tok, pos, done, remaining, out = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), pools, tok, pos, done, remaining, out),
+    )
+    return pools, tok, pos, done, remaining, out, i
+
+
 class DecodeEngine:
     """Continuous micro-batching front-end over the KV-cache decoder.
 
@@ -226,6 +337,8 @@ class DecodeEngine:
     set per structure).  ``admit_every`` is the admission granularity:
     the batch decodes in chunks of that many steps between retirement
     checks — small values admit sooner, large values sync less."""
+
+    kv_backend = "dense"
 
     def __init__(
         self,
@@ -275,9 +388,6 @@ class DecodeEngine:
         self._rng = rng
         # static sampling structure: one compiled program set per value
         self._structure = (temperature == 0.0, top_k, top_p < 1.0)
-        self._caches = init_kv_cache(
-            params, self.batch_size, self.t_max, n_heads=n_heads
-        )
         b = self.batch_size
         self._tok = np.zeros((b,), np.int32)
         self._pos = np.zeros((b,), np.int32)
@@ -344,24 +454,42 @@ class DecodeEngine:
         self._n_admits = 0
         self._chunk_idx = 0
         self._total_new = 0
+        self._peak_active = 0
+        self._init_kv_state()
+
+    def _init_kv_state(self) -> None:
+        """Allocate the dense ``[B, T_max]`` KV buffers (the paged
+        subclass overrides this with a block pool + tables)."""
+        self._caches = init_kv_cache(
+            self.params, self.batch_size, self.t_max, n_heads=self.n_heads
+        )
 
     # -- request intake ---------------------------------------------------
 
+    def _validate_request(self, p: np.ndarray, max_new_tokens: int) -> int:
+        """Check the request against THIS backend's real KV capacity;
+        returns the admission width (prompt bucket).  Backend-specific so
+        the error names what actually ran out — the dense buffer's
+        ``t_max`` window here, the block pool in the paged subclass."""
+        bucket = bucket_for(p.size, self.prompt_buckets)
+        if bucket + max_new_tokens > self.t_max:
+            raise ValueError(
+                f"prompt bucket {bucket} (len {p.size}) + max_new_tokens "
+                f"{max_new_tokens} exceeds the dense KV buffer "
+                f"(t_max={self.t_max})"
+            )
+        return bucket
+
     def submit(self, prompt, max_new_tokens: int) -> int:
         """Queue one prompt (1-D token ids); returns the request id.
-        Validated against the static KV capacity at its bucket size, so
+        Validated against the active backend's real KV capacity, so
         admission can never fail later."""
         p = np.asarray(prompt, np.int32).reshape(-1)
         if p.size == 0:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"want max_new_tokens >= 1; got {max_new_tokens}")
-        bucket = bucket_for(p.size, self.prompt_buckets)
-        if bucket + max_new_tokens > self.t_max:
-            raise ValueError(
-                f"prompt bucket {bucket} (len {p.size}) + max_new_tokens "
-                f"{max_new_tokens} exceeds the KV buffer ({self.t_max})"
-            )
+        bucket = self._validate_request(p, max_new_tokens)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(
@@ -388,12 +516,21 @@ class DecodeEngine:
         has completed.  Returns this call's completions in retirement
         order (also kept in :attr:`completions` by id)."""
         n0 = len(self._order)
-        while self._queue or self.active:
+        while self._has_work():
             self._admit_pending()
+            self._prefill_tick()
             if not self.active:
                 continue  # everything admitted retired instantly
             self._run_chunk()
         return self._order[n0:]
+
+    def _has_work(self) -> bool:
+        return bool(self._queue) or self.active > 0
+
+    def _prefill_tick(self) -> None:
+        """Dense admission prefills whole prompts inside
+        :meth:`_admit_pending`; the paged subclass interleaves one
+        prompt CHUNK per prefilling slot here, between decode chunks."""
 
     def _program(self, key: tuple) -> None:
         """Ledger one executable per key: the compile-count hook's
@@ -456,6 +593,7 @@ class DecodeEngine:
             self._remaining[slot] = req.max_new_tokens - 1
 
     def _run_chunk(self) -> None:
+        self._peak_active = max(self._peak_active, self.active)
         with self.timer.phase("decode", active=self.active):
             rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
             self._chunk_idx += 1
@@ -547,11 +685,502 @@ class DecodeEngine:
 
     def stats(self) -> Dict:
         """Serving report: completions, generated tokens, the per-request
-        latency aggregate, per-phase host timings, and compile counts."""
+        latency aggregate, per-phase host timings, and compile counts.
+        ``peak_active`` is the max rows decoding in one chunk — the
+        engine's observed concurrency (the paged backend's headline)."""
         return {
+            "kv_backend": self.kv_backend,
             "completed": len(self.completions),
             "generated_tokens": self._total_new,
+            "peak_active": self._peak_active,
             "latency": self.latency.summary(),
             "phases": self.timer.summary(),
             **self.compile_stats(),
+        }
+
+
+class PagedDecodeEngine(DecodeEngine):
+    """Paged-KV continuous batching: block-pool memory, chunked prefill,
+    preemption under pressure (docs/SERVING.md "Paged KV serving").
+
+    Same queue surface as :class:`DecodeEngine` (``submit``/``run``/
+    ``stats``), different memory model: K/V live in a shared
+    ``[n_blocks, block_size, H, hd]`` pool per layer; each slot owns an
+    ordered block table.  Three properties follow:
+
+    * **memory-proportional concurrency** — a slot consumes blocks for
+      the tokens it has actually decoded, not a ``T_max`` reservation;
+      ``n_blocks`` (not ``batch_size * T_max``) is the real capacity,
+      so short requests pack many-deep into the same memory.
+    * **chunked prefill** — prompts are left-padded to a block multiple
+      and processed in block-sized chunks under a per-tick TOKEN budget
+      (``prefill_budget``, Sarathi-style), interleaved with decode
+      chunks: admitting a long prompt steals a bounded slice of tower
+      work between decode chunks instead of stalling rows mid-decode.
+    * **preemption, not rejection** — when the pool is exhausted the
+      YOUNGEST occupant is preempted: blocks freed, request requeued at
+      the queue head for recompute on readmission (cheapest victim —
+      the least decode work lost; under greedy decoding the recompute
+      reproduces the same tokens, golden-tested).  If the starved slot
+      is itself the youngest it requeues itself and waits for older
+      rows to retire; submit-time validation guarantees any single
+      request fits an empty pool, so the wait always terminates.
+
+    ONE prefill program plus a short x2 ladder of decode-chunk
+    variants cover any stream (vs the dense engine's
+    one-admit-per-bucket): the ``[1, block_size]`` prefill chunk
+    serves every prompt length, and the decode chunk is keyed only by
+    the active block-WINDOW rung (the gather spans the blocks active
+    rows actually hold, rounded up a power of two — so short requests
+    don't pay ``T_max``-wide attention and the variant count stays
+    logarithmic); block tables, chunk offsets, pool occupancy and
+    admission patterns are all traced operands.
+
+    ``block_size`` trades utilization against program width;
+    ``n_blocks`` defaults to the dense-equivalent footprint
+    (``batch_size * ceil(T_max/block_size) + 1``) — size it DOWN to
+    serve the same stream in less memory, or raise ``batch_size``
+    against the same pool to convert reclaimed padding into
+    concurrency."""
+
+    kv_backend = "paged"
+
+    def __init__(
+        self,
+        params,
+        *,
+        n_heads: int,
+        eos_id: int,
+        batch_size: int = 8,
+        max_seq: Optional[int] = None,
+        block_size: int = 16,
+        n_blocks: Optional[int] = None,
+        prefill_budget: Optional[int] = None,
+        admit_every: int = 8,
+        pad_id: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        rng: Optional[jax.Array] = None,
+        moe_top_k: int = 1,
+        moe_dispatch: str = "dense",
+    ):
+        if block_size < 1:
+            raise ValueError(f"want block_size >= 1; got {block_size}")
+        self.block_size = int(block_size)
+        self._n_blocks_arg = n_blocks
+        # per-tick prefill token budget: how much admission work may
+        # ride between two decode chunks.  The default matches one
+        # decode chunk's per-row depth (admit_every steps) in tokens —
+        # admission and decode then make comparable progress per tick
+        self.prefill_budget = int(
+            prefill_budget if prefill_budget is not None
+            else max(admit_every, 1) * self.block_size
+        )
+        if self.prefill_budget < 1:
+            raise ValueError(
+                f"want prefill_budget >= 1; got {self.prefill_budget}"
+            )
+        super().__init__(
+            params, n_heads=n_heads, eos_id=eos_id,
+            batch_size=batch_size, max_seq=max_seq,
+            admit_every=admit_every, pad_id=pad_id,
+            temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+            moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+        )
+
+    def _init_kv_state(self) -> None:
+        m = -(-self.t_max // self.block_size)  # table width: ceil
+        if self._n_blocks_arg is None:
+            # dense-equivalent default: every slot could hold a full
+            # T_max window (plus the reserved null block) — same memory
+            # as the dense engine, minus nothing; shrink it to save
+            self.n_blocks = self.batch_size * m + 1
+        else:
+            self.n_blocks = int(self._n_blocks_arg)
+        self.blocks_per_row = m
+        self._pools = init_paged_kv(
+            self.params, self.n_blocks, self.block_size,
+            n_heads=self.n_heads,
+        )
+        # LIFO free list: a just-freed (still cache/HBM-warm) block is
+        # the next one handed out; block 0 stays reserved as null
+        self._free: List[int] = list(range(1, self.n_blocks))
+        self._row_blocks: List[List[int]] = [
+            [] for _ in range(self.batch_size)
+        ]
+        self._tables = np.full(
+            (self.batch_size, m), NULL_BLOCK, np.int32
+        )
+        # one admission EVENT per request, ever: a preempted request's
+        # readmission must not re-fire the serve/admit span, the
+        # admitted counter, or the TTFT histogram (its first token was
+        # already produced once — re-observing would double-count)
+        self._admitted_ids: set = set()
+        self._n_preempted = 0
+        self._m_pool = observability.gauge(
+            "znicz_serve_kv_pool_blocks",
+            "paged KV pool blocks by state (the null block is excluded)",
+            ("state",),
+        )
+        self._m_preempted = observability.counter(
+            "znicz_serve_preemptions_total",
+            "requests preempted under pool pressure (freed + requeued)",
+        )
+        self._m_prefill_chunks = observability.counter(
+            "znicz_serve_prefill_chunks_total",
+            "prompt chunks run by the paged prefill program",
+        )
+        self._update_pool_gauges()
+
+    # -- capacity & the block allocator -----------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        """Pool capacity available to requests (null block excluded)."""
+        return self.n_blocks - 1
+
+    def _validate_request(self, p: np.ndarray, max_new_tokens: int) -> int:
+        padded = -(-p.size // self.block_size) * self.block_size
+        total = padded + max_new_tokens
+        need = -(-total // self.block_size)
+        if total > self.t_max:
+            raise ValueError(
+                f"prompt (len {p.size}, padded {padded}) + max_new_tokens "
+                f"{max_new_tokens} exceeds the paged backend's positional "
+                f"window (t_max={self.t_max})"
+            )
+        if need > self.usable_blocks:
+            raise ValueError(
+                f"prompt (len {p.size}, padded {padded}) + max_new_tokens "
+                f"{max_new_tokens} needs {need} KV blocks; exceeds the "
+                f"paged KV pool ({self.usable_blocks} usable blocks x "
+                f"{self.block_size} tokens)"
+            )
+        return padded  # admission width: the padded prompt length
+
+    def _update_pool_gauges(self) -> None:
+        free = len(self._free)
+        self._m_pool.labels(state="free").set(free)
+        self._m_pool.labels(state="used").set(self.usable_blocks - free)
+
+    def _slots_by_age(self) -> List[int]:
+        """Occupied slot indices, oldest admission first — allocation
+        runs in this order so seniority decides who survives pressure."""
+        occ = [
+            (self._slots[i]["seq"], i)
+            for i in range(self.batch_size)
+            if self._slots[i] is not None
+        ]
+        return [i for _, i in sorted(occ)]
+
+    def _youngest_slot(self) -> int:
+        return max(
+            (i for i in range(self.batch_size) if self._slots[i] is not None),
+            key=lambda i: self._slots[i]["seq"],
+        )
+
+    def _free_blocks(self, slot: int) -> None:
+        row = self._row_blocks[slot]
+        self._free.extend(reversed(row))
+        row.clear()
+        self._tables[slot, :] = NULL_BLOCK
+        self._update_pool_gauges()
+
+    def _preempt(self, slot: int) -> None:
+        """Evict ``slot``: free its blocks and requeue its request at
+        the queue HEAD (it is older than anything never admitted), to
+        be recomputed from the prompt on readmission."""
+        st = self._slots[slot]
+        self._free_blocks(slot)
+        self._slots[slot] = None
+        self._done[slot] = True
+        self._remaining[slot] = 0
+        self._tok[slot] = 0
+        self._pos[slot] = 0
+        self._start[slot] = 0
+        self._queue.appendleft(st["req"])
+        self._n_preempted += 1
+        self._m_preempted.inc()
+        self._m_queue_depth.set(len(self._queue))
+
+    def _ensure_blocks(self, slot: int, need: int) -> bool:
+        """Grow ``slot``'s table to >= ``need`` blocks, preempting the
+        youngest occupant whenever the pool is dry.  Returns False when
+        the starved slot was itself the youngest and got preempted
+        (its request is back in the queue)."""
+        row = self._row_blocks[slot]
+        while len(row) < need:
+            if self._free:
+                blk = self._free.pop()
+                self._tables[slot, len(row)] = blk
+                row.append(blk)
+                continue
+            victim = self._youngest_slot()
+            self._preempt(victim)
+            if victim == slot:
+                return False
+        self._update_pool_gauges()
+        return True
+
+    # -- admission: chunked prefill ---------------------------------------
+
+    def _admit_pending(self) -> None:
+        # bind a queued request only when the pool can already hold its
+        # PROMPT beyond what in-flight prefills are still owed.  A fresh
+        # binding always carries the youngest seq, so it can never evict
+        # anyone — prefilling before the blocks exist would just starve,
+        # self-preempt and requeue every tick, burning prefill compute
+        # and inflating the preemption counter for no progress.
+        reserved = sum(
+            s["req"].bucket // self.block_size - len(self._row_blocks[i])
+            for i, s in enumerate(self._slots)
+            if s is not None and s["mode"] == "prefill"
+        )
+        for slot in range(self.batch_size):
+            if self._slots[slot] is None and self._queue:
+                need = self._queue[0].bucket // self.block_size
+                if len(self._free) - reserved < need:
+                    break
+                reserved += need
+                self._start_prefill(slot, self._queue.popleft())
+        self._m_queue_depth.set(len(self._queue))
+        self._m_active.set(self.active)
+
+    def _start_prefill(self, slot: int, req: Request) -> None:
+        """Bind a queued request to a slot; blocks are allocated and
+        chunks run lazily by :meth:`_prefill_tick`, so binding itself
+        can never stall or starve anyone."""
+        pad = req.bucket - req.prompt.size
+        tokens = np.full((1, req.bucket), self.pad_id, np.int32)
+        tokens[0, pad:] = req.prompt
+        self._slots[slot] = {
+            "req": req, "emitted": [], "mode": "prefill",
+            "seq": self._n_admits, "tokens": tokens, "chunks_done": 0,
+            "pad": pad,
+        }
+        self._n_admits += 1
+        self._done[slot] = True
+        self._remaining[slot] = 0
+
+    def _prefill_tick(self) -> None:
+        """Prompt chunks for prefilling slots, oldest first, under a
+        per-tick TOKEN budget (Sarathi-style): the run loop alternates
+        this with a decode chunk, so admission steals at most
+        ``prefill_budget`` tokens' worth of tower work from the batch
+        between decode chunks — a 2048-token prompt admits across a few
+        bounded ticks instead of stalling everyone for one monolithic
+        prefill.  Budget goes to the OLDEST prefill first (it finishes
+        soonest and starts decoding).  While NOTHING is decoding there
+        is nobody to stall, so the budget is waived and chunks run
+        back-to-back."""
+        budget = self.prefill_budget
+        for slot in self._slots_by_age():
+            while budget > 0 or self.active == 0:
+                if not self._prefill_chunk_for(slot):
+                    break
+                budget -= self.block_size
+        self._m_active.set(self.active)
+
+    def _prefill_chunk_for(self, slot: int) -> bool:
+        """Run one prefill chunk for ``slot``; True while the slot
+        remains in prefill mode (False once admitted, retired,
+        preempted, or idle)."""
+        st = self._slots[slot]
+        if st is None or st["mode"] != "prefill":
+            return False  # preempted mid-tick, or already decoding
+        req = st["req"]
+        c = st["chunks_done"]
+        if not self._ensure_blocks(slot, c + 1):
+            return False  # starved AND youngest: requeued itself
+        last = c == req.bucket // self.block_size - 1
+        # FIRST admission only: a preemption-recompute's final chunk
+        # traces as serve/prefill and re-fires nothing, keeping the
+        # one-serve/admit-span-per-request invariant (and the
+        # admitted/TTFT series) exact under preemption
+        first_time = req.id not in self._admitted_ids
+        greedy, top_k, nucleus = self._structure
+        # the LAST chunk is the admission event (first token sampled);
+        # earlier chunks trace as serve/prefill
+        with self.timer.phase(
+            "admit" if last and first_time else "prefill",
+            request=req.id, bucket=req.bucket, chunk=c,
+        ):
+            self._program(("prefill", self.block_size, self._structure))
+            key = jax.random.fold_in(self._rng, st["seq"])
+            self._pools, first = _paged_prefill_prog(
+                self.params, self._pools,
+                jnp.asarray(self._tables[slot]),
+                jnp.asarray(
+                    st["tokens"][
+                        :, c * self.block_size:(c + 1) * self.block_size
+                    ]
+                ),
+                jnp.int32(c * self.block_size),
+                jnp.asarray([st["pad"]], jnp.int32),
+                self._temperature, self._top_p, key,
+                block_size=self.block_size, n_heads=self.n_heads,
+                greedy=greedy, top_k=top_k, nucleus=nucleus,
+                moe_top_k=self.moe_top_k,
+                moe_dispatch=self.moe_dispatch,
+            )
+            st["chunks_done"] = c + 1
+            if last:
+                first = int(first)  # host sync only at admission
+        self._m_prefill_chunks.inc()
+        if not last:
+            return True
+        if first_time:
+            self._admitted_ids.add(req.id)
+            self._m_admitted.inc()
+            self._m_ttft.observe(req.watch.elapsed())
+        if first == self.eos_id:
+            self._retire_slot(slot, [first], "eos")
+        elif req.max_new_tokens == 1:
+            self._retire_slot(slot, [first], "budget")
+        else:
+            st["mode"] = "decode"
+            st["emitted"] = [first]
+            self._tok[slot] = first
+            self._pos[slot] = req.bucket
+            self._start[slot] = st["pad"]
+            self._done[slot] = False
+            self._remaining[slot] = req.max_new_tokens - 1
+        return False
+
+    def _retire_slot(self, slot: int, emitted: List[int], reason: str):
+        self._retire(self._slots[slot]["req"], emitted, reason)
+        self._free_blocks(slot)
+        self._slots[slot] = None
+        self._done[slot] = True
+        self._remaining[slot] = 0
+        # zero the stale row state so an idle slot can never index past
+        # a narrowed decode window
+        self._tok[slot] = 0
+        self._pos[slot] = 0
+        self._start[slot] = 0
+
+    # -- the serving loop -------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return sum(
+            1 for s in self._slots
+            if s is not None and s["mode"] == "decode"
+        )
+
+    @property
+    def prefilling(self) -> int:
+        return sum(
+            1 for s in self._slots
+            if s is not None and s["mode"] == "prefill"
+        )
+
+    def _has_work(self) -> bool:
+        return bool(self._queue) or self.active > 0 or self.prefilling > 0
+
+    def _run_chunk(self) -> None:
+        # lazy per-chunk allocation, oldest first: each decoding row
+        # gets blocks covering the positions THIS chunk can write
+        # (min(chunk, remaining) steps) — never the whole budget up
+        # front; exhaustion preempts the youngest occupant
+        for slot in self._slots_by_age():
+            st = self._slots[slot]
+            if st is None or st["mode"] != "decode":
+                continue
+            steps = min(self.admit_every, int(self._remaining[slot]))
+            last_pos = int(self._pos[slot]) + max(steps - 1, 0)
+            self._ensure_blocks(slot, last_pos // self.block_size + 1)
+        if not self.active:
+            return  # allocation pressure preempted every decoder
+        self._peak_active = max(self._peak_active, self.active)
+        # decode WINDOW: the gather spans only the blocks active rows
+        # actually hold (rounded up a x2 rung so the compiled-variant
+        # count stays logarithmic), not the full T_max-wide table — with
+        # paged KV, T_max stops bounding per-step attention cost too.
+        # Allocation above already covers this chunk's growth, so the
+        # window cannot be outrun mid-chunk; retired/idle rows were
+        # zeroed and write to the null block regardless.
+        need = max(
+            (len(self._row_blocks[i]) for i, s in enumerate(self._slots)
+             if s is not None and s["mode"] == "decode"),
+            default=1,
+        )
+        window = 1
+        while window < need:
+            window *= 2
+        window = min(window, self.blocks_per_row)
+        with self.timer.phase("decode", active=self.active):
+            rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
+            self._chunk_idx += 1
+            greedy, top_k, nucleus = self._structure
+            self._program(
+                ("paged_chunk", self.admit_every, self.batch_size,
+                 window, self._structure)
+            )
+            (pools, tok, pos, done, remaining, out, steps) = (
+                _paged_decode_chunk(
+                    self.params, self._pools,
+                    jnp.asarray(self._tables[:, :window]),
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    jnp.asarray(self._start), jnp.asarray(self._done),
+                    jnp.asarray(self._remaining), self._temperature,
+                    self._top_p, rng, chunk=self.admit_every,
+                    block_size=self.block_size, t_max=self.t_max,
+                    n_heads=self.n_heads, eos_id=self.eos_id,
+                    greedy=greedy, top_k=top_k, nucleus=nucleus,
+                    moe_top_k=self.moe_top_k,
+                    moe_dispatch=self.moe_dispatch,
+                )
+            )
+            self._pools = pools
+            out = np.asarray(out)
+            steps = int(steps)
+            self._tok = np.array(tok)
+            self._pos = np.array(pos)
+            self._done = np.array(done)
+            self._remaining = np.array(remaining)
+        for slot, st in enumerate(self._slots):
+            if st is None or st["mode"] != "decode":
+                continue
+            req, emitted = st["req"], st["emitted"]
+            reason = None
+            for t in out[slot, :steps]:
+                emitted.append(int(t))
+                if int(t) == self.eos_id:
+                    reason = "eos"
+                    break
+                if len(emitted) >= req.max_new_tokens:
+                    reason = "budget"
+                    break
+            if reason is not None:
+                self._retire_slot(slot, emitted, reason)
+        self._m_active.set(self.active)
+
+    # -- introspection ----------------------------------------------------
+
+    def compile_stats(self) -> Dict:
+        """Paged ledger: one ``("prefill", block_size, structure)``
+        entry plus one ``("paged_chunk", chunk, B, window, structure)``
+        entry per x2 window rung the stream's occupancy ever reached —
+        logarithmic in T_max/block_size, independent of request count —
+        cross-checked against the paged programs' jit caches (shared
+        process-wide, like the dense ones)."""
+        return {
+            "programs": dict(self._programs),
+            "n_programs": len(self._programs),
+            "program_hits": self._program_hits,
+            "prefill_jit_entries": _paged_prefill_prog._cache_size(),
+            "paged_chunk_jit_entries": _paged_decode_chunk._cache_size(),
+        }
+
+    def stats(self) -> Dict:
+        """Adds the block-pool view to the base report: pool capacity,
+        current free blocks, and this engine's preemption count."""
+        return {
+            **super().stats(),
+            "pool_blocks": self.usable_blocks,
+            "pool_blocks_free": len(self._free),
+            "block_size": self.block_size,
+            "preemptions": self._n_preempted,
         }

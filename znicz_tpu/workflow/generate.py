@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu import observability
+from znicz_tpu.ops.attention import paged_attention
 from znicz_tpu.ops.normalization import layer_norm
 from znicz_tpu.workflow.transformer import _block_ffn
 
@@ -162,6 +163,165 @@ def decode_step(
         )
         new_caches.append(cache)
     return new_caches, x[:, 0] @ params[-1]["head"]
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache (vLLM/PagedAttention lineage, docs/SERVING.md): K/V live
+# in a shared [n_blocks, block_size, H, hd] pool per layer and each row
+# owns an ordered block table — block-granular allocation instead of a
+# dense [B, T_max] reservation per slot, so memory scales with the tokens
+# actually decoded and the pool's free blocks ARE the concurrency budget.
+
+NULL_BLOCK = 0  # reserved pool block: write target for idle/done rows
+
+
+def init_paged_kv(params, n_blocks: int, block_size: int, *, n_heads: int):
+    """Zeroed ``[n_blocks, block_size, H, hd]`` K/V pools, one pair per
+    block of the tower.  Pool block ``NULL_BLOCK`` (index 0) is reserved
+    as the null write target — allocators must hand out ``1..n_blocks-1``
+    — so rows with nothing to say (done, idle slot) can always write
+    somewhere harmless instead of branching."""
+    if n_blocks < 2 or block_size < 1:
+        raise ValueError(
+            f"want n_blocks >= 2 (one is the reserved null block) and "
+            f"block_size >= 1; got {n_blocks}, {block_size}"
+        )
+    pools = []
+    for block in params[1:-1]:
+        inner = block["wq"].shape[1]
+        head_dim = inner // n_heads
+        shape = (n_blocks, block_size, n_heads, head_dim)
+        dtype = block["wq"].dtype
+        pools.append(
+            {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        )
+    return pools
+
+
+def _paged_block_step(
+    block, x, pool, write, tables, q_pos, *, n_heads, block_size,
+    start=None, moe_top_k=1, moe_dispatch="dense",
+):
+    """One pre-LN block over ``x`` [B, Tq, D] with paged KV: ``write``
+    scatters this layer's new K/V into the pool (the caller resolves
+    block ids once — the same indices serve every layer) and attention
+    gathers through the block table (:func:`ops.attention.paged_attention`
+    — same masked stable-softmax numerics as the dense
+    :func:`_block_step`, asserted by the paged goldens)."""
+    b, tq, _ = x.shape
+    h = layer_norm(x, block["ln1_scale"], block["ln1_bias"])
+
+    def proj(w):
+        y = jnp.dot(h, w, preferred_element_type=jnp.float32).astype(h.dtype)
+        return y.reshape(b, tq, n_heads, -1)
+
+    q, k_new, v_new = proj(block["wq"]), proj(block["wk"]), proj(block["wv"])
+    k_pool = write(pool["k"], k_new)
+    v_pool = write(pool["v"], v_new)
+    o = paged_attention(
+        q, k_pool, v_pool, tables, q_pos, block_size=block_size,
+        start=start,
+    )
+    o = o.reshape(b, tq, -1)
+    x = x + jnp.dot(
+        o, block["wo"], preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    h = layer_norm(x, block["ln2_scale"], block["ln2_bias"])
+    x = x + _block_ffn(
+        block, h, moe_top_k=moe_top_k, moe_dispatch=moe_dispatch
+    )
+    return x, {"k": k_pool, "v": v_pool}
+
+
+def paged_prefill_chunk(
+    params, pools, table, tokens, offset, *, n_heads, block_size,
+    start=None, moe_top_k=1, moe_dispatch="dense",
+):
+    """Process ONE aligned chunk of a single prompt through the tower,
+    writing its K/V into the row's blocks; returns ``(pools, logits)``
+    at the chunk's last position.
+
+    ``tokens`` is ``[1, C]`` with ``C == block_size`` and ``offset`` a
+    multiple of ``block_size`` — the chunk occupies exactly one block,
+    so the write is one whole-block scatter and the compiled program has
+    a SINGLE shape regardless of prompt length (chunked prefill's whole
+    point: a long prompt is N invocations of this one program,
+    interleavable with decode chunks, instead of one monolithic
+    per-bucket prefill that stalls the batch).  ``table`` is the row's
+    [M] block table; ``start`` [1] marks the first real token of a
+    LEFT-padded prompt (pad is numerically inert exactly as in
+    :func:`prefill`).  Left-padding to a block multiple keeps the
+    chunk's — and therefore the prompt's — last position real, so the
+    final chunk's logits are the first-token logits."""
+    c = tokens.shape[1]
+    if c != block_size:
+        raise ValueError(
+            f"chunk length {c} must equal block_size {block_size} "
+            "(one chunk == one block)"
+        )
+    blk = table[offset // block_size]
+    x = _embed_at(params[0], tokens, offset, start)
+    q_pos = offset + jnp.arange(c)[None, :]
+
+    def write(pool, new):
+        return pool.at[blk].set(new[0])
+
+    new_pools = []
+    for block, pool in zip(params[1:-1], pools):
+        x, pool = _paged_block_step(
+            block, x, pool, write, table[None], q_pos, n_heads=n_heads,
+            block_size=block_size, start=start, moe_top_k=moe_top_k,
+            moe_dispatch=moe_dispatch,
+        )
+        new_pools.append(pool)
+    return new_pools, x[:, -1] @ params[-1]["head"]
+
+
+def paged_decode_step(
+    params, pools, tables, token, pos, *, n_heads, block_size,
+    start=None, write_mask=None, moe_top_k=1, moe_dispatch="dense",
+):
+    """One incremental paged step: ``token`` [B] at PER-ROW positions
+    ``pos`` [B] -> ``(pools, next logits [B, vocab])``.
+
+    Each row writes its new K/V at ``(tables[b, pos_b // bs],
+    pos_b % bs)`` — rows own disjoint blocks, so the batched scatter
+    never collides — and attends through its own table.  Rows with
+    ``write_mask`` False (done/idle slots) write to the reserved
+    ``NULL_BLOCK`` instead, so a retired-but-still-carried row can
+    never scribble into a block the allocator has handed to someone
+    else.  Per-row positions are native here (no vmap-into-scatter as
+    in the dense engine chunk): the block table IS the indirection."""
+    b = token.shape[0]
+    rows = jnp.arange(b)
+    blk = tables[rows, pos // block_size]
+    if write_mask is not None:
+        blk = jnp.where(write_mask, blk, NULL_BLOCK)
+    slot = pos % block_size
+    x = _embed_rows(params[0], token, pos, start)
+
+    def write(pool, new):
+        return pool.at[blk, slot].set(new[:, 0])
+
+    new_pools = []
+    for block, pool in zip(params[1:-1], pools):
+        x, pool = _paged_block_step(
+            block, x, pool, write, tables, pos[:, None], n_heads=n_heads,
+            block_size=block_size, start=start, moe_top_k=moe_top_k,
+            moe_dispatch=moe_dispatch,
+        )
+        new_pools.append(pool)
+    return new_pools, x[:, 0] @ params[-1]["head"]
+
+
+def _embed_rows(embed, token, pos, start=None):
+    """Token + positional embedding for one token per row at PER-ROW
+    absolute positions ``pos`` [B] (the paged decode twin of
+    :func:`_embed_at`, which takes one shared offset).  With ``start``
+    the position index is row-relative, same left-padding contract."""
+    rel = pos if start is None else pos - start
+    rel = jnp.clip(rel, 0, embed["pos"].shape[0] - 1)
+    return embed["embed"][token[:, None]] + embed["pos"][rel[:, None]]
 
 
 def _sample(logits, key, temperature, top_k, nucleus, top_p):

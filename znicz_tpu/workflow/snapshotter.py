@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from znicz_tpu import observability
+
 FORMAT_VERSION = 1
 
 
@@ -144,19 +146,27 @@ class Snapshotter:
         *,
         tag: str,
     ) -> str:
-        payload = {
-            "format_version": FORMAT_VERSION,
-            "train_state": _to_host(train_state),  # collective on multi-host
-            "host_state": host_state or {},
-        }
-        path = self._path(tag)
-        if not self.writer:
-            return path  # bookkeeping stays identical across processes
-        opener = gzip.open if self.compress else open
-        tmp = path + ".tmp"
-        with opener(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
+        # spans land the snapshot cost on the Perfetto timeline next to
+        # the train/serve phases it steals wall time from: gather is the
+        # (possibly collective) device->host readback, write the
+        # pickle+fsync-side file cost
+        with observability.span("snapshot/save", tag=tag):
+            with observability.span("snapshot/gather"):
+                payload = {
+                    "format_version": FORMAT_VERSION,
+                    # collective on multi-host
+                    "train_state": _to_host(train_state),
+                    "host_state": host_state or {},
+                }
+            path = self._path(tag)
+            if not self.writer:
+                return path  # bookkeeping stays identical across processes
+            opener = gzip.open if self.compress else open
+            tmp = path + ".tmp"
+            with observability.span("snapshot/write", path=path):
+                with opener(tmp, "wb") as f:
+                    pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, path)
         return path
 
     def load(self, path: str) -> Tuple[Any, Dict[str, Any]]:
