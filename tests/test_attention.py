@@ -159,6 +159,88 @@ class TestPagedAttention:
             np.asarray(clean), np.asarray(poisoned), rtol=1e-6
         )
 
+    def test_many_tables_one_block_aliasing(self):
+        # prefix sharing maps ONE physical block into MANY tables: each
+        # row's output must equal the dense reference over the content
+        # its own table resolves to — the gather must not care that a
+        # block id repeats across rows
+        bs = 8
+        rng = np.random.default_rng(5)
+        b, t, h, d = 3, 24, 2, 8
+        m = t // bs
+        shared = rng.normal(size=(bs, h, d)).astype(np.float32)
+        shared_v = rng.normal(size=(bs, h, d)).astype(np.float32)
+        k = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        v = rng.normal(size=(b, t, h, d)).astype(np.float32)
+        # every row's FIRST block is the shared prefix content
+        k[:, :bs] = shared
+        v[:, :bs] = shared_v
+        # pool: block 1 = the one shared block; per-row private tails
+        n_blocks = 2 + b * (m - 1)
+        k_pool = np.zeros((n_blocks, bs, h, d), np.float32)
+        v_pool = np.zeros((n_blocks, bs, h, d), np.float32)
+        k_pool[1], v_pool[1] = shared, shared_v
+        table = np.zeros((b, m), np.int32)
+        table[:, 0] = 1  # ALIASED: all three tables point at block 1
+        nxt = 2
+        for row in range(b):
+            for j in range(1, m):
+                table[row, j] = nxt
+                k_pool[nxt] = k[row, j * bs:(j + 1) * bs]
+                v_pool[nxt] = v[row, j * bs:(j + 1) * bs]
+                nxt += 1
+        rngq = np.random.default_rng(6)
+        q = rngq.normal(size=(b, 1, h, d)).astype(np.float32)
+        # rows at DIFFERENT depths through the same shared block: row 0
+        # still inside it, rows 1/2 past it
+        pos = np.asarray([[5], [13], [21]], np.int32)
+        start = np.zeros((b,), np.int32)
+        out = attention.paged_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(pos), block_size=bs,
+            start=jnp.asarray(start),
+        )
+        ref = self._dense_ref(q, k, v, pos, start)
+        np.testing.assert_allclose(
+            np.asarray(out), ref, rtol=2e-5, atol=2e-6
+        )
+
+    def test_aliased_block_validity_is_per_row(self):
+        # poison-grade check for aliasing: positions of the SHARED
+        # block past a shallow row's pos are real live content for a
+        # deeper row.  Perturbing them must leave the shallow row's
+        # output bit-identical (masked by index) while changing the
+        # deeper row's (it genuinely attends them).
+        bs = 8
+        rng = np.random.default_rng(7)
+        h, d = 2, 8
+        shared_k = rng.normal(size=(bs, h, d)).astype(np.float32)
+        shared_v = rng.normal(size=(bs, h, d)).astype(np.float32)
+        k_pool = np.zeros((3, bs, h, d), np.float32)
+        v_pool = np.zeros((3, bs, h, d), np.float32)
+        k_pool[1], v_pool[1] = shared_k, shared_v
+        k_pool[2] = rng.normal(size=(bs, h, d)).astype(np.float32)
+        v_pool[2] = rng.normal(size=(bs, h, d)).astype(np.float32)
+        table = np.asarray([[1, 0], [1, 2]], np.int32)
+        q = rng.normal(size=(2, 1, h, d)).astype(np.float32)
+        pos = np.asarray([[3], [11]], np.int32)  # row 0 shallow, row 1 deep
+
+        def run(kp, vp):
+            return np.asarray(
+                attention.paged_attention(
+                    jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                    jnp.asarray(table), jnp.asarray(pos), block_size=bs,
+                )
+            )
+
+        clean = run(k_pool, v_pool)
+        kp, vp = k_pool.copy(), v_pool.copy()
+        kp[1, 5:] += 3.0  # rewrite shared-block positions 5..7
+        vp[1, 5:] += 3.0
+        pert = run(kp, vp)
+        np.testing.assert_array_equal(clean[0], pert[0])  # masked out
+        assert np.abs(clean[1] - pert[1]).max() > 1e-6  # really attended
+
     def test_pallas_stub_delegates_to_reference(self):
         from znicz_tpu.ops.pallas import paged_attention as pp
 

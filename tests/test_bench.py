@@ -1,0 +1,122 @@
+"""bench.py section harness: schema, isolation, selection, retry.
+
+Tier-1 (no TPU): the bench driver parses ONE JSON object per line, so
+the section runner must emit exactly that — a ``{"metric": ...}``
+record per succeeding section and an ``{"error": ..., "section": ...}``
+record for a failing one, with every OTHER section's records intact
+(BENCH_r05 lost a whole round to one init flake).  Sections here are
+monkeypatched fast fakes; the real measurement bodies never run.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _collect(sections, only=None):
+    lines = []
+    failed = bench.run_sections(
+        sections=sections,
+        only=only,
+        emit_record=lambda rec: lines.append(json.dumps(rec)),
+    )
+    return lines, failed
+
+
+def _ok_section(name, value):
+    def fn(ctx):
+        ctx[name] = value
+        return [{"metric": name, "value": value, "unit": "u"}]
+
+    return (name, fn)
+
+
+def _boom_section(name, exc=RuntimeError):
+    def fn(ctx):
+        raise exc(f"{name} exploded")
+
+    return (name, fn)
+
+
+class TestSectionIsolation:
+    def test_every_line_is_one_parseable_json_record(self):
+        lines, failed = _collect(
+            [_ok_section("a_rate", 1.5), _ok_section("b_rate", 2.5)]
+        )
+        assert failed == []
+        assert len(lines) == 2
+        for line in lines:
+            rec = json.loads(line)  # one object per line, parseable
+            assert "\n" not in line
+            assert "metric" in rec and "value" in rec
+
+    def test_one_failing_section_cannot_zero_the_run(self):
+        # the BENCH_r05 regression shape: a mid-run failure must emit
+        # its own error record and leave neighbors' records intact
+        lines, failed = _collect(
+            [
+                _ok_section("before_rate", 1.0),
+                _boom_section("flaky", RuntimeError),
+                _ok_section("after_rate", 2.0),
+            ]
+        )
+        assert failed == ["flaky"]
+        recs = [json.loads(x) for x in lines]
+        assert [r.get("metric") for r in recs] == [
+            "before_rate", None, "after_rate",
+        ]
+        err = recs[1]
+        assert err["error"] == "RuntimeError"
+        assert err["section"] == "flaky"
+        assert "exploded" in err["detail"]
+
+    def test_only_prefix_selects_sections(self):
+        sections = [
+            _ok_section("lm_serve_rate", 1.0),
+            _ok_section("lm_serve_paged_rate", 2.0),
+            _ok_section("alexnet_rate", 3.0),
+        ]
+        lines, failed = _collect(sections, only="lm_serve")
+        assert failed == []
+        got = {json.loads(x)["metric"] for x in lines}
+        assert got == {"lm_serve_rate", "lm_serve_paged_rate"}
+
+    def test_registered_sections_cover_the_headline_metrics(self):
+        names = [name for name, _ in bench._SECTIONS]
+        assert names == sorted(set(names), key=names.index)  # unique
+        for expected in (
+            "alexnet_step", "lm_train", "lm_serve", "lm_serve_paged",
+            "lm_serve_prefix",
+        ):
+            assert expected in names
+
+
+class TestBackendRetry:
+    def test_init_backend_retries_then_succeeds(self):
+        calls = []
+
+        def probe():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError(
+                    "Unable to initialize backend 'axon': UNAVAILABLE"
+                )
+            return ["dev0"]
+
+        assert bench._init_backend(retries=3, delay=0.0, probe=probe) == [
+            "dev0"
+        ]
+        assert len(calls) == 3
+
+    def test_init_backend_gives_up_after_bounded_attempts(self):
+        calls = []
+
+        def probe():
+            calls.append(1)
+            raise RuntimeError("UNAVAILABLE")
+
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            bench._init_backend(retries=3, delay=0.0, probe=probe)
+        assert len(calls) == 3

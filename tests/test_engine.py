@@ -202,3 +202,19 @@ class TestEngineSmoke:
             eng.submit(np.arange(5, dtype=np.int32), 60)  # 16 + 60 > 64
         with pytest.raises(ValueError, match="eos_id"):
             DecodeEngine(params, n_heads=HEADS, eos_id=99)
+
+    def test_prefix_cache_requires_the_paged_backend(self):
+        # the dense layout has no shareable blocks: asking for the
+        # prefix cache must fail loudly, never be silently ignored
+        params = _params()
+        with pytest.raises(
+            ValueError, match="prefix cache requires the paged backend"
+        ):
+            DecodeEngine(
+                params, n_heads=HEADS, eos_id=EOS, prefix_cache=True
+            )
+        # explicit off (and the default) stay accepted
+        eng = DecodeEngine(
+            params, n_heads=HEADS, eos_id=EOS, prefix_cache=False
+        )
+        assert eng.kv_backend == "dense"

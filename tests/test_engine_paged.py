@@ -173,12 +173,14 @@ class TestBlockPool:
     def test_retire_frees_and_readmit_reuses_blocks(self):
         # white-box allocator check: a retired request's blocks return
         # to the pool and the next admission reuses them (LIFO free
-        # list) instead of fragmenting toward fresh blocks
+        # list) instead of fragmenting toward fresh blocks.  Prefix
+        # cache OFF: with it on, retired blocks park in the cache
+        # instead of the free list (tests/test_engine_prefix.py)
         params = _params()
         gen = np.random.default_rng(21)
         pa = gen.integers(0, 17, (12,)).astype(np.int32)  # 2 blocks
         pb = gen.integers(0, 17, (10,)).astype(np.int32)
-        eng = _engine(params, batch_size=1)
+        eng = _engine(params, batch_size=1, prefix_cache=False)
         ra = eng.submit(pa, 4)
         eng._admit_pending()
         # nothing is decoding, so the whole prompt prefills this tick:
@@ -225,7 +227,10 @@ class TestBlockPool:
         params = _params()
         gen = np.random.default_rng(25)
         p = gen.integers(0, 17, (5,)).astype(np.int32)
-        eng = _engine(params, batch_size=1, eos_id=15, admit_every=4)
+        eng = _engine(
+            params, batch_size=1, eos_id=15, admit_every=4,
+            prefix_cache=False,
+        )
         eng.submit(p, 20)
         eng._admit_pending()
         eng._prefill_tick()

@@ -66,7 +66,13 @@ def paged_attention(
     window is GATHERED from the pool (``k_pool[block_table]``), so the
     compiled program is shape-static in everything but the traced table
     values: rows growing into new blocks, block reuse after retirement,
-    and any pool size never recompile.
+    and any pool size never recompile.  ALIASING is first-class: many
+    tables may reference the same physical block (prefix sharing — the
+    engine refcounts and COW-splits before any write), the gather reads
+    it once per referencing row, and validity stays PER-ROW — a shared
+    block's positions past one row's ``q_pos`` are masked for that row
+    even while a deeper row genuinely attends them (aliasing tests in
+    tests/test_attention.py).
 
     Validity is by ABSOLUTE key index, exactly like the dense cache
     path (:mod:`znicz_tpu.workflow.generate`): key position must be

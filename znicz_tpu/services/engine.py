@@ -17,14 +17,20 @@ stream:
   scatter), so rows at different depths decode together and no prompt
   length or admission pattern ever recompiles it.
 
-**Paged backend** (:class:`PagedDecodeEngine`, PAPERS.md vLLM/Sarathi
-lineage): instead of a dense ``[B, T_max]`` reservation per slot, K/V
-live in a shared block pool (``[n_blocks, block_size, H, hd]`` per
-layer) and each slot owns a block table.  Admission allocates blocks
+**Paged backend** (:class:`PagedDecodeEngine`, PAPERS.md vLLM/Sarathi/
+RadixAttention lineage): instead of a dense ``[B, T_max]`` reservation
+per slot, K/V live in a shared block pool (``[n_blocks, block_size, H,
+hd]`` per layer) and each slot owns a block table over REFCOUNTED
+blocks.  Admission maps the longest prefix of the prompt already in the
+content-hash PREFIX CACHE (chained block hashes — an implicit radix
+structure; retiring and preempted requests publish their completed full
+blocks) and chunk-prefills only the uncached tail; shared blocks are
+read-only behind a copy-on-write guard.  Blocks are otherwise allocated
 lazily as decode advances, prompts prefill in block-sized CHUNKS
 interleaved with decode chunks (a long prompt never stalls the batch),
-and when the pool runs dry the engine PREEMPTS the youngest request —
-frees its blocks, requeues it for recompute-on-readmission — instead
+and when the free list runs dry allocation first EVICTS cache-only
+blocks (LRU) and only then PREEMPTS the youngest request — publishes +
+releases its blocks, requeues it for recompute-on-readmission — instead
 of rejecting.  Concurrency is bounded by memory actually used, not by
 ``n_slots * T_max`` worst case; docs/SERVING.md has the tuning table.
 
@@ -44,7 +50,8 @@ compile counts are introspectable via
 from __future__ import annotations
 
 import dataclasses
-from collections import deque
+import hashlib
+from collections import OrderedDict, deque
 from functools import partial
 from typing import Deque, Dict, List, Optional, Sequence
 
@@ -61,6 +68,7 @@ from znicz_tpu.workflow.generate import (
     _params_fingerprint,
     _sample,
     bucket_for,
+    copy_paged_block,
     decode_step,
     init_kv_cache,
     init_paged_kv,
@@ -78,6 +86,10 @@ from znicz_tpu.workflow.generate import (
 # lifetime first-compile metric.)
 _COMPILED_KEYS: set = set()
 
+# seed of the prefix-cache hash chain (versioned: bump if block content
+# semantics ever change, so stale-looking hashes can't alias)
+_PREFIX_SEED = b"znicz-prefix-v1"
+
 
 @dataclasses.dataclass
 class Request:
@@ -88,13 +100,18 @@ class Request:
     max_new_tokens: int
     bucket: int  # prompt-length bucket it will be admitted at
     watch: profiling.Stopwatch  # started at submit; read at retirement
+    ttft_s: Optional[float] = None  # set once at FIRST admission
+    # memoized prefix-cache hash chain (pure function of the prompt —
+    # computed once per request; block RESOLUTION stays per-tick fresh)
+    digests: Optional[List[bytes]] = None
 
 
 @dataclasses.dataclass
 class Completion:
     """A finished request: prompt + generated tokens plus its serving
     metrics.  ``latency_s`` is submit -> retirement (queue wait
-    included — the number a caller actually experiences)."""
+    included — the number a caller actually experiences); ``ttft_s`` is
+    submit -> first sampled token."""
 
     id: int
     tokens: np.ndarray  # prompt + generated, EOS included when hit
@@ -103,6 +120,7 @@ class Completion:
     latency_s: float
     tokens_per_sec: float
     bucket: int
+    ttft_s: Optional[float] = None
 
 
 def _sample_tok(logits, key, temperature, top_p, *, greedy, top_k, nucleus):
@@ -235,27 +253,37 @@ def _decode_chunk(
     donate_argnums=(1,),
 )
 def _paged_prefill_prog(
-    params, pools, table, tokens, offset, start, temperature, top_p,
-    key, *, block_size, n_heads, greedy, top_k, nucleus, moe_top_k,
-    moe_dispatch,
+    params, pools, table, tokens, offset, start, last, temperature,
+    top_p, key, *, block_size, n_heads, greedy, top_k, nucleus,
+    moe_top_k, moe_dispatch,
 ):
     """One aligned prompt chunk into the row's blocks + first-token
     sample.  ONE compiled shape covers every prompt length and every
-    chunk index (``offset``/``table`` are traced operands; the chunk is
-    always ``[1, block_size]``) — chunked prefill's compile story beats
-    the dense path's one-admit-program-per-bucket.  The sample only
-    matters on the final chunk; computing it unconditionally keeps the
-    program single and costs one argmax/categorical per chunk."""
+    chunk index (``offset``/``table``/``last`` are traced operands; the
+    chunk is always ``[1, block_size]``) — chunked prefill's compile
+    story beats the dense path's one-admit-program-per-bucket.  ``last``
+    is the in-chunk index of the prompt's final real token (the tail of
+    the final chunk is RIGHT-pad — prefix-cache alignment); the sample
+    only matters on the final chunk; computing it unconditionally keeps
+    the program single and costs one argmax/categorical per chunk."""
     pools, logits = paged_prefill_chunk(
         params, pools, table, tokens, offset, n_heads=n_heads,
-        block_size=block_size, start=start, moe_top_k=moe_top_k,
-        moe_dispatch=moe_dispatch,
+        block_size=block_size, start=start, last=last,
+        moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
     )
     first = _sample_tok(
         logits, key, temperature, top_p, greedy=greedy, top_k=top_k,
         nucleus=nucleus,
     )
     return pools, first[0]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _cow_copy_prog(pools, src, dst):
+    """Copy-on-write block split (:func:`copy_paged_block` with the
+    pools donated): ``src``/``dst`` are traced, so one compiled program
+    serves every split of one pool geometry."""
+    return copy_paged_block(pools, src, dst)
 
 
 @partial(
@@ -357,11 +385,18 @@ class DecodeEngine:
         rng: Optional[jax.Array] = None,
         moe_top_k: int = 1,
         moe_dispatch: str = "dense",
+        prefix_cache: Optional[bool] = None,
     ):
         if batch_size < 1 or admit_every < 1:
             raise ValueError(
                 f"want batch_size >= 1 and admit_every >= 1; got "
                 f"{batch_size}, {admit_every}"
+            )
+        if prefix_cache:
+            raise ValueError(
+                "prefix cache requires the paged backend "
+                "(PagedDecodeEngine): the dense [B, T_max] KV layout has "
+                "no shareable blocks to map across requests"
             )
         max_pos = params[0]["pos"].shape[0]
         self.t_max = int(max_seq or max_pos)
@@ -579,7 +614,8 @@ class DecodeEngine:
             )
             first = int(first)
         self._m_admitted.inc()
-        self._m_ttft.observe(req.watch.elapsed())
+        req.ttft_s = req.watch.elapsed()
+        self._m_ttft.observe(req.ttft_s)
         if first == self.eos_id:
             self._retire(req, [first], "eos")
         elif req.max_new_tokens == 1:
@@ -656,6 +692,7 @@ class DecodeEngine:
             latency_s=dt,
             tokens_per_sec=len(emitted) / max(dt, 1e-9),
             bucket=req.bucket,
+            ttft_s=req.ttft_s,
         )
         self._order.append(comp)
         self.completions[req.id] = comp
@@ -700,31 +737,50 @@ class DecodeEngine:
 
 
 class PagedDecodeEngine(DecodeEngine):
-    """Paged-KV continuous batching: block-pool memory, chunked prefill,
-    preemption under pressure (docs/SERVING.md "Paged KV serving").
+    """Paged-KV continuous batching: refcounted copy-on-write block
+    pool, cross-request prefix cache, chunked prefill, preemption under
+    pressure (docs/SERVING.md "Paged KV serving").
 
     Same queue surface as :class:`DecodeEngine` (``submit``/``run``/
     ``stats``), different memory model: K/V live in a shared
     ``[n_blocks, block_size, H, hd]`` pool per layer; each slot owns an
-    ordered block table.  Three properties follow:
+    ordered block table and every pool block carries a REFCOUNT — the
+    same physical block can appear in many tables at once.  Four
+    properties follow:
 
     * **memory-proportional concurrency** — a slot consumes blocks for
       the tokens it has actually decoded, not a ``T_max`` reservation;
       ``n_blocks`` (not ``batch_size * T_max``) is the real capacity,
       so short requests pack many-deep into the same memory.
-    * **chunked prefill** — prompts are left-padded to a block multiple
-      and processed in block-sized chunks under a per-tick TOKEN budget
-      (``prefill_budget``, Sarathi-style), interleaved with decode
-      chunks: admitting a long prompt steals a bounded slice of tower
-      work between decode chunks instead of stalling rows mid-decode.
-    * **preemption, not rejection** — when the pool is exhausted the
-      YOUNGEST occupant is preempted: blocks freed, request requeued at
-      the queue head for recompute on readmission (cheapest victim —
-      the least decode work lost; under greedy decoding the recompute
-      reproduces the same tokens, golden-tested).  If the starved slot
-      is itself the youngest it requeues itself and waits for older
-      rows to retire; submit-time validation guarantees any single
-      request fits an empty pool, so the wait always terminates.
+    * **prefix reuse (RadixAttention/vLLM lineage)** — retiring (and
+      preempted) requests publish their COMPLETED full blocks into a
+      prefix cache keyed by CHAINED content hash (block j's key commits
+      to all tokens before it — an implicit radix structure); admission
+      maps the longest cached block-chain prefix of the prompt into the
+      new table with refcount bumps and chunk-prefills only the
+      uncached tail.  A fully-cached system prompt costs zero prefill
+      FLOPs (one chunk reruns for the first-token logits) and TTFT
+      collapses to the tail.  Shared blocks are READ-ONLY: a write into
+      a block other tables or the cache reference COW-splits it first.
+      Prompts anchor at position 0 and right-pad the final chunk so a
+      shared prefix fills identical block contents whatever the full
+      prompt's length.
+    * **chunked prefill** — prompts are processed in block-sized chunks
+      under a per-tick TOKEN budget (``prefill_budget``,
+      Sarathi-style), interleaved with decode chunks: admitting a long
+      prompt steals a bounded slice of tower work between decode chunks
+      instead of stalling rows mid-decode.
+    * **eviction before preemption** — when the free list is dry,
+      allocation first EVICTS the least-recently-used cache-only block
+      (refcount 0, cache-referenced); only when the cache too is empty
+      is the YOUNGEST occupant preempted: publishes its full blocks to
+      the cache, releases its references, requeues at the queue head
+      for recompute on readmission (often straight out of its own
+      just-cached blocks).  Refcounts keep survivors' shared blocks
+      alive through any preemption.  If the starved slot is itself the
+      youngest it requeues itself and waits for older rows to retire;
+      submit-time validation guarantees any single request fits an
+      empty pool, so the wait always terminates.
 
     ONE prefill program plus a short x2 ladder of decode-chunk
     variants cover any stream (vs the dense engine's
@@ -733,15 +789,18 @@ class PagedDecodeEngine(DecodeEngine):
     the active block-WINDOW rung (the gather spans the blocks active
     rows actually hold, rounded up a power of two — so short requests
     don't pay ``T_max``-wide attention and the variant count stays
-    logarithmic); block tables, chunk offsets, pool occupancy and
-    admission patterns are all traced operands.
+    logarithmic); block tables, chunk offsets, pool occupancy,
+    admission patterns AND prefix-cache hits are all traced operands —
+    prefix reuse adds ZERO compiled programs, it only skips iterations
+    of the existing chunk program.
 
     ``block_size`` trades utilization against program width;
     ``n_blocks`` defaults to the dense-equivalent footprint
     (``batch_size * ceil(T_max/block_size) + 1``) — size it DOWN to
     serve the same stream in less memory, or raise ``batch_size``
     against the same pool to convert reclaimed padding into
-    concurrency."""
+    concurrency.  ``prefix_cache=False`` disables sharing (blocks then
+    free directly at release, LIFO)."""
 
     kv_backend = "paged"
 
@@ -756,6 +815,7 @@ class PagedDecodeEngine(DecodeEngine):
         block_size: int = 16,
         n_blocks: Optional[int] = None,
         prefill_budget: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
         admit_every: int = 8,
         pad_id: Optional[int] = None,
         temperature: float = 0.0,
@@ -769,6 +829,11 @@ class PagedDecodeEngine(DecodeEngine):
             raise ValueError(f"want block_size >= 1; got {block_size}")
         self.block_size = int(block_size)
         self._n_blocks_arg = n_blocks
+        # ON by default: sharing is free when nothing matches (a few
+        # sha256 per admission) and the headline win when it does
+        self.prefix_cache = True if prefix_cache is None else bool(
+            prefix_cache
+        )
         # per-tick prefill token budget: how much admission work may
         # ride between two decode chunks.  The default matches one
         # decode chunk's per-row depth (admit_every steps) in tokens —
@@ -806,12 +871,25 @@ class PagedDecodeEngine(DecodeEngine):
         # LIFO free list: a just-freed (still cache/HBM-warm) block is
         # the next one handed out; block 0 stays reserved as null
         self._free: List[int] = list(range(1, self.n_blocks))
+        # per-block refcount = how many tables reference it; the cache
+        # reference is tracked separately by _block_hash membership
+        self._ref = np.zeros(self.n_blocks, np.int64)
+        # prefix cache: chained content hash -> block, its inverse, and
+        # an LRU over CACHE-ONLY blocks (refcount 0: evictable)
+        self._cache: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        self._lru: OrderedDict = OrderedDict()
         self._row_blocks: List[List[int]] = [
             [] for _ in range(self.batch_size)
         ]
         self._tables = np.full(
             (self.batch_size, m), NULL_BLOCK, np.int32
         )
+        self._n_prefix_hits = 0
+        self._n_prefix_misses = 0
+        self._n_cached_tokens = 0
+        self._n_evictions = 0
+        self._n_cow = 0
         # one admission EVENT per request, ever: a preempted request's
         # readmission must not re-fire the serve/admit span, the
         # admitted counter, or the TTFT histogram (its first token was
@@ -830,6 +908,22 @@ class PagedDecodeEngine(DecodeEngine):
         self._m_prefill_chunks = observability.counter(
             "znicz_serve_prefill_chunks_total",
             "prompt chunks run by the paged prefill program",
+        )
+        self._m_prefix_hits = observability.counter(
+            "znicz_serve_prefix_hits_total",
+            "prompt blocks mapped from the prefix cache at admission",
+        )
+        self._m_prefix_misses = observability.counter(
+            "znicz_serve_prefix_misses_total",
+            "full prompt blocks that missed the prefix cache at admission",
+        )
+        self._m_prefix_tokens = observability.counter(
+            "znicz_serve_prefix_cached_tokens_total",
+            "prompt tokens whose prefill was skipped via the prefix cache",
+        )
+        self._m_prefix_evictions = observability.counter(
+            "znicz_serve_prefix_evictions_total",
+            "cached blocks evicted to satisfy allocation pressure",
         )
         self._update_pool_gauges()
 
@@ -861,8 +955,12 @@ class PagedDecodeEngine(DecodeEngine):
 
     def _update_pool_gauges(self) -> None:
         free = len(self._free)
+        cached = len(self._lru)
         self._m_pool.labels(state="free").set(free)
-        self._m_pool.labels(state="used").set(self.usable_blocks - free)
+        self._m_pool.labels(state="cached").set(cached)
+        self._m_pool.labels(state="used").set(
+            self.usable_blocks - free - cached
+        )
 
     def _slots_by_age(self) -> List[int]:
         """Occupied slot indices, oldest admission first — allocation
@@ -880,19 +978,72 @@ class PagedDecodeEngine(DecodeEngine):
             key=lambda i: self._slots[i]["seq"],
         )
 
-    def _free_blocks(self, slot: int) -> None:
+    def _incref(self, blk: int) -> None:
+        self._ref[blk] += 1
+
+    def _decref(self, blk: int) -> None:
+        """Drop one table reference; at zero the block becomes
+        EVICTABLE cache (if published) or returns to the free list."""
+        self._ref[blk] -= 1
+        if self._ref[blk] == 0:
+            if blk in self._block_hash:
+                # fresh insertion lands at the MRU end (a block enters
+                # the LRU only here, and claiming removed it first)
+                self._lru[blk] = None
+            else:
+                self._free.append(blk)
+
+    def _alloc_block(self) -> int:
+        """One unreferenced, uncached block: free list first, then
+        EVICT the least-recently-used cache-only block — the cache
+        always yields before any live request is preempted.  Returns
+        -1 when both are dry (the caller preempts)."""
+        if self._free:
+            return self._free.pop()
+        if self._lru:
+            blk, _ = self._lru.popitem(last=False)
+            del self._cache[self._block_hash.pop(blk)]
+            self._n_evictions += 1
+            self._m_prefix_evictions.inc()
+            return blk
+        return -1
+
+    def _alloc_for(self, slot: int) -> Optional[int]:
+        """One referenced block for ``slot``, preempting the youngest
+        occupant while the pool (free list AND evictable cache) stays
+        dry.  None when the starved slot was itself the youngest and
+        got preempted (its request is back in the queue)."""
+        while True:
+            blk = self._alloc_block()
+            if blk >= 0:
+                self._incref(blk)
+                return blk
+            victim = self._youngest_slot()
+            self._preempt(victim)
+            if victim == slot:
+                return None
+
+    def _release_row(self, slot: int) -> None:
+        """Drop every table reference of ``slot`` (reverse order keeps
+        the free list LIFO — last-allocated, still-warm block first)."""
         row = self._row_blocks[slot]
-        self._free.extend(reversed(row))
+        for blk in reversed(row):
+            self._decref(blk)
         row.clear()
         self._tables[slot, :] = NULL_BLOCK
         self._update_pool_gauges()
 
     def _preempt(self, slot: int) -> None:
-        """Evict ``slot``: free its blocks and requeue its request at
-        the queue HEAD (it is older than anything never admitted), to
-        be recomputed from the prompt on readmission."""
+        """Evict ``slot``: publish its completed full blocks into the
+        prefix cache (cache-only blocks are the first thing allocation
+        consumes, so a transient preemption often readmits straight out
+        of its own just-cached prefix), release its table references
+        and requeue its request at the queue HEAD (it is older than
+        anything never admitted), to be recomputed on readmission.
+        Refcounts keep any block a SURVIVOR also maps alive."""
         st = self._slots[slot]
-        self._free_blocks(slot)
+        self._publish_row(slot)
+        self._release_row(slot)
         self._slots[slot] = None
         self._done[slot] = True
         self._remaining[slot] = 0
@@ -911,57 +1062,223 @@ class PagedDecodeEngine(DecodeEngine):
         (its request is back in the queue)."""
         row = self._row_blocks[slot]
         while len(row) < need:
-            if self._free:
-                blk = self._free.pop()
-                self._tables[slot, len(row)] = blk
-                row.append(blk)
-                continue
-            victim = self._youngest_slot()
-            self._preempt(victim)
-            if victim == slot:
+            blk = self._alloc_for(slot)
+            if blk is None:
                 return False
+            self._tables[slot, len(row)] = blk
+            row.append(blk)
         self._update_pool_gauges()
         return True
+
+    def _shared(self, blk: int) -> bool:
+        """A block this row must NOT write into: other tables still
+        reference it, or the prefix cache does (a write would corrupt
+        content a future lookup trusts)."""
+        return self._ref[blk] > 1 or blk in self._block_hash
+
+    def _cow_split(self, slot: int, j: int, *, copy: bool) -> bool:
+        """Copy-on-write: retarget table entry ``j`` of ``slot`` to a
+        fresh private block before a write into a shared/cached block.
+        ``copy=False`` when the impending write rewrites the whole
+        block (a prefill chunk re-run) — the fresh block needs no
+        content.  No-op for private blocks.  False when allocation had
+        to preempt ``slot`` itself."""
+        blk = int(self._row_blocks[slot][j])
+        if not self._shared(blk):
+            return True
+        new = self._alloc_for(slot)
+        if new is None:
+            return False
+        if copy:
+            self._program(("cow", self.block_size))
+            self._pools = _cow_copy_prog(
+                self._pools, jnp.int32(blk), jnp.int32(new)
+            )
+        self._decref(blk)
+        self._row_blocks[slot][j] = new
+        self._tables[slot, j] = new
+        self._n_cow += 1
+        self._update_pool_gauges()
+        return True
+
+    # -- the prefix cache -------------------------------------------------
+
+    def _chain_hashes(self, tokens: np.ndarray):
+        """Chained sha256 over full ``block_size``-token blocks of
+        ``tokens``: block j's key commits to ALL tokens before it, so
+        equal keys mean equal K/V content, and walking the chain until
+        the first miss is the longest-cached-prefix descent of an
+        implicit radix structure."""
+        h = _PREFIX_SEED
+        bs = self.block_size
+        for j in range(tokens.size // bs):
+            h = hashlib.sha256(
+                h
+                + np.ascontiguousarray(
+                    tokens[j * bs:(j + 1) * bs]
+                ).tobytes()
+            ).digest()
+            yield h
+
+    def _lookup_prefix(self, req: Request) -> List[int]:
+        """Longest cached block-chain prefix of the request's prompt
+        (full blocks only — a divergence mid-block misses from that
+        block on).  Claim-free: the caller bumps refcounts when it
+        binds.  The hash chain is memoized on the request (content-
+        pure); only the hash -> block resolution reads live state."""
+        hits: List[int] = []
+        if not self.prefix_cache:
+            return hits
+        if req.digests is None:
+            req.digests = list(self._chain_hashes(req.prompt))
+        for h in req.digests:
+            blk = self._cache.get(h)
+            if blk is None:
+                break
+            hits.append(blk)
+        return hits
+
+    def _publish_row(self, slot: int) -> None:
+        """Retire/preempt hook: publish this row's COMPLETED full
+        blocks (every position holds a real token's K/V) into the
+        prefix cache.  First writer wins when two physical blocks hold
+        the same content — the duplicate stays private and frees
+        normally at release."""
+        if not self.prefix_cache:
+            return
+        st = self._slots[slot]
+        req = st["req"]
+        emitted = st.get("emitted") or []
+        if st["mode"] == "prefill":
+            covered = min(
+                st["chunks_done"] * self.block_size, req.prompt.size
+            )
+        else:
+            # contiguous K/V coverage: the whole prompt plus every
+            # emitted token EXCEPT the last (sampled, never fed back,
+            # so its K/V was never written)
+            covered = req.prompt.size + max(len(emitted) - 1, 0)
+        row = self._row_blocks[slot]
+        n_full = min(covered // self.block_size, len(row))
+        if not n_full:
+            return
+        toks = np.concatenate(
+            [req.prompt, np.asarray(emitted, np.int32)]
+        )[: n_full * self.block_size]
+        for j, h in enumerate(self._chain_hashes(toks)):
+            blk = int(row[j])
+            if h in self._cache or blk in self._block_hash:
+                continue  # already published (a mapped prefix), or dup
+            self._cache[h] = blk
+            self._block_hash[blk] = h
+
+    def flush_prefix_cache(self) -> int:
+        """Drop every cache entry; cache-only blocks return to the
+        free list (blocks live requests still reference just lose their
+        hash and free normally at release).  Returns entries dropped."""
+        n = len(self._cache)
+        self._cache.clear()
+        self._block_hash.clear()
+        self._free.extend(self._lru)
+        self._lru.clear()
+        self._update_pool_gauges()
+        return n
 
     # -- admission: chunked prefill ---------------------------------------
 
     def _admit_pending(self) -> None:
-        # bind a queued request only when the pool can already hold its
-        # PROMPT beyond what in-flight prefills are still owed.  A fresh
-        # binding always carries the youngest seq, so it can never evict
-        # anyone — prefilling before the blocks exist would just starve,
-        # self-preempt and requeue every tick, burning prefill compute
-        # and inflating the preemption counter for no progress.
+        # bind a queued request only when the pool can already hold the
+        # UNCACHED part of its prompt beyond what in-flight prefills are
+        # still owed (a prefix-cache hit consumes no allocation — the
+        # blocks are already resident).  A fresh binding always carries
+        # the youngest seq, so it can never evict anyone — prefilling
+        # before the blocks exist would just starve, self-preempt and
+        # requeue every tick, burning prefill compute and inflating the
+        # preemption counter for no progress.
+        # owed == 0 with the row still in prefill mode is exactly the
+        # fully-cached case: its final chunk will COW-split one block
         reserved = sum(
-            s["req"].bucket // self.block_size - len(self._row_blocks[i])
+            max(
+                s["req"].bucket // self.block_size
+                - len(self._row_blocks[i]),
+                1,
+            )
             for i, s in enumerate(self._slots)
             if s is not None and s["mode"] == "prefill"
         )
         for slot in range(self.batch_size):
             if self._slots[slot] is None and self._queue:
-                need = self._queue[0].bucket // self.block_size
-                if len(self._free) - reserved < need:
+                req = self._queue[0]
+                hits = self._lookup_prefix(req)
+                # a fully-cached prompt still COW-reruns its final
+                # block's chunk for the first-token logits
+                need = max(req.bucket // self.block_size - len(hits), 1)
+                # allocatable = free + evictable cache, NOT counting the
+                # hit blocks themselves (binding pins them)
+                pool = (
+                    len(self._free)
+                    + len(self._lru)
+                    - sum(1 for b in hits if b in self._lru)
+                )
+                if pool - reserved < need:
                     break
                 reserved += need
-                self._start_prefill(slot, self._queue.popleft())
+                self._start_prefill(slot, self._queue.popleft(), hits)
         self._m_queue_depth.set(len(self._queue))
         self._m_active.set(self.active)
 
-    def _start_prefill(self, slot: int, req: Request) -> None:
-        """Bind a queued request to a slot; blocks are allocated and
-        chunks run lazily by :meth:`_prefill_tick`, so binding itself
-        can never stall or starve anyone."""
-        pad = req.bucket - req.prompt.size
+    def _start_prefill(
+        self, slot: int, req: Request, hits: Optional[List[int]] = None
+    ) -> None:
+        """Bind a queued request to a slot: claim the longest cached
+        block-chain prefix of its prompt (refcount bumps pin the blocks
+        under the binder) and queue only the UNCACHED tail for chunked
+        prefill.  Tail blocks are allocated and chunks run lazily by
+        :meth:`_prefill_tick`, so binding itself can never stall or
+        starve anyone.  Prompts anchor at position 0 and RIGHT-pad the
+        final chunk to the block boundary — the prefix-cache alignment
+        contract (see :func:`~znicz_tpu.workflow.generate
+        .paged_prefill_chunk`)."""
+        size = req.prompt.size
         tokens = np.full((1, req.bucket), self.pad_id, np.int32)
-        tokens[0, pad:] = req.prompt
+        tokens[0, :size] = req.prompt
+        row = self._row_blocks[slot]
+        if hits is None:
+            # _admit_pending passes its own lookup through (nothing can
+            # mutate the cache in between); this walk serves direct
+            # white-box callers only
+            hits = self._lookup_prefix(req)
+        for j, blk in enumerate(hits):
+            self._incref(blk)
+            if blk in self._lru:
+                del self._lru[blk]
+            self._tables[slot, j] = blk
+            row.append(blk)
+        # a fully-cached prompt still needs its first-token LOGITS: the
+        # final block's chunk re-runs (the write guard COW-splits it off
+        # the shared block), so at least one chunk always executes
+        skip = (
+            len(hits) - 1
+            if hits and len(hits) * self.block_size == size
+            else len(hits)
+        )
+        if self.prefix_cache:
+            n_lookup = size // self.block_size
+            self._n_prefix_hits += len(hits)
+            self._n_prefix_misses += n_lookup - len(hits)
+            self._n_cached_tokens += skip * self.block_size
+            self._m_prefix_hits.inc(len(hits))
+            self._m_prefix_misses.inc(n_lookup - len(hits))
+            self._m_prefix_tokens.inc(skip * self.block_size)
         self._slots[slot] = {
             "req": req, "emitted": [], "mode": "prefill",
-            "seq": self._n_admits, "tokens": tokens, "chunks_done": 0,
-            "pad": pad,
+            "seq": self._n_admits, "tokens": tokens,
+            "chunks_done": skip,
         }
         self._n_admits += 1
         self._done[slot] = True
         self._remaining[slot] = 0
+        self._update_pool_gauges()
 
     def _prefill_tick(self) -> None:
         """Prompt chunks for prefilling slots, oldest first, under a
@@ -990,9 +1307,16 @@ class PagedDecodeEngine(DecodeEngine):
         if st is None or st["mode"] != "prefill":
             return False  # preempted mid-tick, or already decoding
         req = st["req"]
+        size = req.prompt.size
         c = st["chunks_done"]
         if not self._ensure_blocks(slot, c + 1):
             return False  # starved AND youngest: requeued itself
+        # a prefill chunk rewrites its whole block: when the target is
+        # a mapped cached block (the fully-cached-prompt re-run for
+        # first-token logits) COW-split it — copy-free, every slot is
+        # about to be overwritten — so shared content stays pristine
+        if not self._cow_split(slot, c, copy=False):
+            return False
         last = c == req.bucket // self.block_size - 1
         # FIRST admission only: a preemption-recompute's final chunk
         # traces as serve/prefill and re-fires nothing, keeping the
@@ -1017,7 +1341,12 @@ class PagedDecodeEngine(DecodeEngine):
                     ]
                 ),
                 jnp.int32(c * self.block_size),
-                jnp.asarray([st["pad"]], jnp.int32),
+                jnp.zeros((1,), jnp.int32),
+                jnp.int32(
+                    (size - 1) % self.block_size
+                    if last
+                    else self.block_size - 1
+                ),
                 self._temperature, self._top_p, key,
                 block_size=self.block_size, n_heads=self.n_heads,
                 greedy=greedy, top_k=top_k, nucleus=nucleus,
@@ -1032,8 +1361,9 @@ class PagedDecodeEngine(DecodeEngine):
             return True
         if first_time:
             self._admitted_ids.add(req.id)
+            req.ttft_s = req.watch.elapsed()
             self._m_admitted.inc()
-            self._m_ttft.observe(req.watch.elapsed())
+            self._m_ttft.observe(req.ttft_s)
         if first == self.eos_id:
             self._retire_slot(slot, [first], "eos")
         elif req.max_new_tokens == 1:
@@ -1042,15 +1372,17 @@ class PagedDecodeEngine(DecodeEngine):
             st["mode"] = "decode"
             st["emitted"] = [first]
             self._tok[slot] = first
-            self._pos[slot] = req.bucket
-            self._start[slot] = st["pad"]
+            self._pos[slot] = size
+            self._start[slot] = 0
             self._done[slot] = False
             self._remaining[slot] = req.max_new_tokens - 1
         return False
 
     def _retire_slot(self, slot: int, emitted: List[int], reason: str):
+        self._slots[slot]["emitted"] = emitted
+        self._publish_row(slot)
         self._retire(self._slots[slot]["req"], emitted, reason)
-        self._free_blocks(slot)
+        self._release_row(slot)
         self._slots[slot] = None
         self._done[slot] = True
         self._remaining[slot] = 0
@@ -1089,8 +1421,25 @@ class PagedDecodeEngine(DecodeEngine):
             if st is None or st["mode"] != "decode":
                 continue
             steps = min(self.admit_every, int(self._remaining[slot]))
-            last_pos = int(self._pos[slot]) + max(steps - 1, 0)
-            self._ensure_blocks(slot, last_pos // self.block_size + 1)
+            p0 = int(self._pos[slot])
+            last_pos = p0 + max(steps - 1, 0)
+            if not self._ensure_blocks(
+                slot, last_pos // self.block_size + 1
+            ):
+                continue  # starved AND youngest: requeued itself
+            # a decode write must never land in a shared/cached block:
+            # COW-split (with copy — the block holds earlier positions'
+            # live K/V) any write-range block still shared.  Structurally
+            # unreachable under block-aligned sharing + publish-at-retire
+            # (mapped blocks are full, decode writes past them), but the
+            # guard keeps the invariant under ANY future publish policy.
+            for j in range(
+                p0 // self.block_size, last_pos // self.block_size + 1
+            ):
+                if self._slots[slot] is None:
+                    break  # a COW allocation preempted this very row
+                if not self._cow_split(slot, j, copy=True):
+                    break
         if not self.active:
             return  # allocation pressure preempted every decoder
         self._peak_active = max(self._peak_active, self.active)
@@ -1172,15 +1521,28 @@ class PagedDecodeEngine(DecodeEngine):
             "program_hits": self._program_hits,
             "prefill_jit_entries": _paged_prefill_prog._cache_size(),
             "paged_chunk_jit_entries": _paged_decode_chunk._cache_size(),
+            "cow_jit_entries": _cow_copy_prog._cache_size(),
         }
 
     def stats(self) -> Dict:
-        """Adds the block-pool view to the base report: pool capacity,
-        current free blocks, and this engine's preemption count."""
+        """Adds the block-pool + prefix-cache view to the base report.
+        ``pool_blocks_free`` counts ALLOCATABLE blocks — the free list
+        plus evictable cache-only blocks (``pool_blocks_cached``); a
+        cached block a live request also maps counts as used."""
         return {
             **super().stats(),
             "pool_blocks": self.usable_blocks,
-            "pool_blocks_free": len(self._free),
+            "pool_blocks_free": len(self._free) + len(self._lru),
+            "pool_blocks_cached": len(self._lru),
             "block_size": self.block_size,
             "preemptions": self._n_preempted,
+            "prefix_cache": {
+                "enabled": self.prefix_cache,
+                "entries": len(self._cache),
+                "hits": self._n_prefix_hits,
+                "misses": self._n_prefix_misses,
+                "cached_tokens": self._n_cached_tokens,
+                "evictions": self._n_evictions,
+                "cow_splits": self._n_cow,
+            },
         }

@@ -235,11 +235,11 @@ def _paged_block_step(
 
 def paged_prefill_chunk(
     params, pools, table, tokens, offset, *, n_heads, block_size,
-    start=None, moe_top_k=1, moe_dispatch="dense",
+    start=None, last=None, moe_top_k=1, moe_dispatch="dense",
 ):
     """Process ONE aligned chunk of a single prompt through the tower,
     writing its K/V into the row's blocks; returns ``(pools, logits)``
-    at the chunk's last position.
+    at the chunk's ``last`` position (its final position by default).
 
     ``tokens`` is ``[1, C]`` with ``C == block_size`` and ``offset`` a
     multiple of ``block_size`` — the chunk occupies exactly one block,
@@ -248,11 +248,20 @@ def paged_prefill_chunk(
     point: a long prompt is N invocations of this one program,
     interleavable with decode chunks, instead of one monolithic
     per-bucket prefill that stalls the batch).  ``table`` is the row's
-    [M] block table; ``start`` [1] marks the first real token of a
-    LEFT-padded prompt (pad is numerically inert exactly as in
-    :func:`prefill`).  Left-padding to a block multiple keeps the
-    chunk's — and therefore the prompt's — last position real, so the
-    final chunk's logits are the first-token logits."""
+    [M] block table.
+
+    Prompts anchor at position 0 and the FINAL chunk is RIGHT-padded to
+    the block boundary (prefix-cache alignment: a shared prefix fills
+    identical block contents whatever the full prompt's length — a left
+    pad would shift every block by ``-len % block_size`` and kill
+    sharing).  ``last`` (traced) is the in-chunk index of the prompt's
+    last real token, so the returned logits are the first-token logits
+    even when the tail of the chunk is pad.  The pad positions DO write
+    (garbage) K/V at absolute positions past the prompt, but validity
+    is by absolute index — no query ever attends a position it hasn't
+    reached — and incremental decode overwrites each pad slot before
+    its position becomes visible.  ``start`` [1] is retained for
+    left-padded callers (legacy tests); the engine passes zeros."""
     c = tokens.shape[1]
     if c != block_size:
         raise ValueError(
@@ -274,7 +283,30 @@ def paged_prefill_chunk(
             moe_dispatch=moe_dispatch,
         )
         new_pools.append(pool)
-    return new_pools, x[:, -1] @ params[-1]["head"]
+    if last is None:
+        xl = x[:, -1]
+    else:
+        xl = jax.lax.dynamic_index_in_dim(x, last, axis=1, keepdims=False)
+    return new_pools, xl @ params[-1]["head"]
+
+
+def copy_paged_block(pools, src, dst):
+    """Copy pool block ``src`` into ``dst`` across every layer's K/V
+    pool — the copy-on-write split for paged prefix sharing: when a row
+    must write into a block other tables (or the prefix cache) still
+    reference, the engine allocates a fresh block, copies the shared
+    content here, and retargets only its own table entry.  ``src`` and
+    ``dst`` are traced operands, so one compiled program serves every
+    split."""
+    new_pools = []
+    for pool in pools:
+        new_pools.append(
+            {
+                "k": pool["k"].at[dst].set(pool["k"][src]),
+                "v": pool["v"].at[dst].set(pool["v"][src]),
+            }
+        )
+    return new_pools
 
 
 def paged_decode_step(
