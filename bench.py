@@ -112,10 +112,25 @@ def _init_backend(retries: int = 3, delay: float = 2.0, probe=None):
     raise last
 
 
-def run_sections(sections=None, only=None, emit_record=emit):
-    """Run bench sections under per-section isolation; returns the list
-    of failed section names.  Records flow through ``emit_record`` (one
-    call per record) — injectable for the tier-1 schema test."""
+def run_sections(sections=None, only=None, emit_record=emit,
+                 budget_s=None):
+    """Run bench sections under per-section isolation AND a per-section
+    wall-clock budget; returns the list of failed section names.
+    Records flow through ``emit_record`` (one call per record) —
+    injectable for the tier-1 schema test.
+
+    Each section runs on a worker thread joined with ``budget_s``
+    (default ``BENCH_SECTION_BUDGET_S`` env, 900 s): a HUNG section —
+    a wedged device call, a deadlocked engine — emits its own
+    ``{"error": "timeout", "section": ...}`` record and the round moves
+    on instead of stalling forever.  The abandoned worker is daemonic;
+    it may keep contending for the device until the process exits, so
+    a timeout can degrade (not zero) the sections after it — the
+    timeout record names the culprit."""
+    import threading
+
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_SECTION_BUDGET_S", "900"))
     ctx: dict = {}
     failed = []
     for name, fn in (_SECTIONS if sections is None else sections):
@@ -123,12 +138,40 @@ def run_sections(sections=None, only=None, emit_record=emit):
             continue
         t0 = time.time()
         print(f"=== section {name}", file=sys.stderr)
-        try:
-            for rec in fn(ctx) or []:
-                emit_record(rec)
-        except Exception as e:
+        holder: dict = {}
+
+        def _worker(fn=fn):
+            try:
+                holder["records"] = list(fn(ctx) or [])
+            except Exception as e:  # reported by the join below
+                holder["exc"] = e
+
+        worker = threading.Thread(
+            target=_worker, name=f"bench-{name}", daemon=True
+        )
+        worker.start()
+        worker.join(timeout=budget_s if budget_s > 0 else None)
+        if worker.is_alive():
             failed.append(name)
-            traceback.print_exc(file=sys.stderr)
+            emit_record(
+                {
+                    "error": "timeout",
+                    "section": name,
+                    "budget_s": budget_s,
+                }
+            )
+            print(
+                f"=== section {name} TIMED OUT after {budget_s:.0f}s "
+                "(worker abandoned)",
+                file=sys.stderr,
+            )
+            continue
+        if "exc" in holder:
+            e = holder["exc"]
+            failed.append(name)
+            traceback.print_exception(
+                type(e), e, e.__traceback__, file=sys.stderr
+            )
             emit_record(
                 {
                     "error": type(e).__name__,
@@ -136,6 +179,9 @@ def run_sections(sections=None, only=None, emit_record=emit):
                     "detail": str(e)[:500],
                 }
             )
+        else:
+            for rec in holder.get("records", []):
+                emit_record(rec)
         print(
             f"=== section {name} done in {time.time() - t0:.1f}s",
             file=sys.stderr,
@@ -1265,6 +1311,170 @@ def _sec_lm_serve_prefix(ctx):
             "lm_serve_prefix_evictions": pstats.get("evictions", 0),
             "lm_serve_prefix_cow_splits": pstats.get("cow_splits", 0),
             "lm_serve_prefix_compiles": warm_st.get("n_programs", 0),
+        }
+    ]
+
+
+@_section("lm_serve_frontdoor")
+def _sec_lm_serve_frontdoor(ctx):
+    # FRONT DOOR serving (ISSUE 6): the same mixed-prompt stream
+    # replayed through the REAL HTTP surface — concurrent clients POST
+    # /generate against a ServingFrontDoor-owned paged engine and read
+    # chunked token streams back.  Reported as a SERVICE, not a
+    # library: sustained requests/sec over the timed window, host-side
+    # TTFT p99 (first streamed token, queue + HTTP included), and the
+    # shed/deadline/cancel/restart tallies that say how the admission
+    # ladder behaved under the load.
+    import http.client
+    import threading
+
+    import numpy as np
+
+    from znicz_tpu.services import serve as serve_mod
+    from znicz_tpu.services.engine import PagedDecodeEngine
+    from znicz_tpu.services.frontdoor import ServingFrontDoor
+
+    cfg, b = LM_MID, LM_MID_B
+    n_requests, n_clients = 4 * b, 4
+    door = srv = None
+    try:
+        params = _lm_serve_params()
+
+        def factory():
+            return PagedDecodeEngine(
+                params, n_heads=cfg["n_heads"], eos_id=0, batch_size=b,
+                admit_every=8, max_seq=256,
+                block_size=LM_SERVE_PAGED_BLOCK,
+            )
+
+        door = ServingFrontDoor(
+            factory, max_pending=2 * n_requests,
+            default_deadline_s=300.0,
+        )
+        srv = serve_mod.build_server(directory=".", port=0, frontdoor=door)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        reqs = np.random.default_rng(12)
+        prompts = [
+            reqs.integers(
+                1, cfg["vocab"],
+                (LM_SERVE_LENS[j % len(LM_SERVE_LENS)],),
+            ).astype(np.int32).tolist()
+            for j in range(n_requests)
+        ]
+
+        def one_request(prompt):
+            t_req = time.time()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=300
+            )
+            try:
+                conn.request(
+                    "POST", "/generate",
+                    body=json.dumps(
+                        {"prompt": prompt,
+                         "max_new_tokens": LM_SERVE_NEW}
+                    ),
+                )
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    resp.read()
+                    return {"status": resp.status}
+                out = {"status": 200, "n_new": 0, "ttft_s": None}
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    rec = json.loads(line)
+                    if "token" in rec:
+                        if out["ttft_s"] is None:
+                            out["ttft_s"] = time.time() - t_req
+                        out["n_new"] += 1
+                    elif rec.get("done"):
+                        out["finish_reason"] = rec.get("finish_reason")
+                out["latency_s"] = time.time() - t_req
+                return out
+            finally:
+                conn.close()
+
+        one_request(prompts[0])  # warm every program through HTTP
+        todo = list(prompts)
+        results: list = []
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    if not todo:
+                        return
+                    prompt = todo.pop()
+                r = one_request(prompt)
+                with lock:
+                    results.append(r)
+
+        clients = [
+            threading.Thread(target=client, daemon=True)
+            for _ in range(n_clients)
+        ]
+        t0 = time.time()
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(timeout=600)
+        wall = time.time() - t0
+        ok = [
+            r for r in results
+            if r.get("status") == 200
+            and r.get("finish_reason") in ("eos", "budget")
+        ]
+        ttfts = sorted(
+            r["ttft_s"] for r in ok if r.get("ttft_s") is not None
+        )
+        ttft_p99 = (
+            ttfts[min(len(ttfts) - 1, int(round(0.99 * (len(ttfts) - 1))))]
+            if ttfts else 0.0
+        )
+        toks = sum(r.get("n_new", 0) for r in results)
+        st = door.stats()
+    finally:
+        if srv is not None and door is not None:
+            serve_mod.shutdown_gracefully(srv, door, grace_s=10.0)
+        _lm_cleanup()
+    print(
+        f"LM serving FRONT DOOR ({n_clients} HTTP clients, "
+        f"{n_requests} mixed requests): {len(ok) / wall:.2f} req/s, "
+        f"{toks / wall:.0f} tok/s, TTFT p99 {1000 * ttft_p99:.0f} ms; "
+        f"shed={sum(st['rejected'].values())} "
+        f"deadline={st['deadline_exceeded']} "
+        f"restarts={st['watchdog_restarts']}",
+        file=sys.stderr,
+    )
+    return [
+        {
+            "metric": "lm_serve_frontdoor_rps",
+            "value": round(len(ok) / wall, 3),
+            "unit": "requests/sec",
+            "lm_serve_frontdoor_config": (
+                f"mid config paged engine behind ServingFrontDoor + "
+                f"HTTP: B={b} slots, block {LM_SERVE_PAGED_BLOCK}, "
+                f"{n_clients} concurrent clients streaming "
+                f"{n_requests} mixed prompts {LM_SERVE_LENS}, budget "
+                f"{LM_SERVE_NEW}"
+            ),
+            "lm_serve_frontdoor_tokens_per_sec": round(toks / wall, 1),
+            "lm_serve_frontdoor_ttft_p99_ms": round(1000 * ttft_p99, 1),
+            "lm_serve_frontdoor_completed": len(ok),
+            "lm_serve_frontdoor_rejected": sum(st["rejected"].values()),
+            "lm_serve_frontdoor_deadline_exceeded": st[
+                "deadline_exceeded"
+            ],
+            "lm_serve_frontdoor_cancelled": st["cancelled"],
+            "lm_serve_frontdoor_watchdog_restarts": st[
+                "watchdog_restarts"
+            ],
+            "lm_serve_frontdoor_compiles": st["engine"].get(
+                "n_programs", 0
+            ),
         }
     ]
 

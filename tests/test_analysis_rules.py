@@ -1266,6 +1266,108 @@ class TestWallClockDuration:
         assert fs == []
 
 
+# -- ZNC010: unbounded blocking in services/ ------------------------------
+
+
+SERVICES_PATH = "znicz_tpu/services/mod.py"
+
+
+class TestUnboundedBlocking:
+    def test_queue_get_without_timeout_fires(self):
+        fs = run(
+            """
+            import queue
+
+            def pull(q):
+                return q.get()
+            """,
+            "ZNC010",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC010"]
+        assert "timeout" in fs[0].message
+
+    def test_event_wait_and_thread_join_and_acquire_fire(self):
+        fs = run(
+            """
+            def sync(evt, thread, lock):
+                evt.wait()
+                thread.join()
+                lock.acquire()
+            """,
+            "ZNC010",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC010"] * 3
+
+    def test_bounded_calls_are_quiet(self):
+        fs = run(
+            """
+            def sync(q, evt, thread, lock, grace):
+                q.get(timeout=1.0)
+                q.get_nowait()
+                evt.wait(timeout=grace)
+                thread.join(grace)
+                lock.acquire(timeout=0.5)
+                lock.acquire(False)
+                lock.acquire(blocking=False)
+                q.get(block=False)
+            """,
+            "ZNC010",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_non_blocking_homonyms_are_quiet(self):
+        # str.join / dict.get / sound-alike methods with args must not
+        # be confused with synchronization primitives
+        fs = run(
+            """
+            def fmt(parts, d, k):
+                return ", ".join(parts) + str(d.get(k))
+            """,
+            "ZNC010",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_module_level_wait_is_quiet(self):
+        fs = run(
+            """
+            import os
+
+            def reap():
+                return os.wait()
+            """,
+            "ZNC010",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_outside_services_is_quiet(self):
+        fs = run(
+            """
+            def pull(q):
+                return q.get()
+            """,
+            "ZNC010",
+            path="znicz_tpu/loader/prefetch.py",
+        )
+        assert fs == []
+
+    def test_pragma_exempts(self):
+        fs = run(
+            """
+            def pull(q):
+                # the producer is in-process and cannot die silently
+                return q.get()  # znicz-check: disable=ZNC010
+            """,
+            "ZNC010",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+
 # -- pragmas -------------------------------------------------------------
 
 

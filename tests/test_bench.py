@@ -15,12 +15,15 @@ import pytest
 import bench
 
 
-def _collect(sections, only=None):
+def _collect(sections, only=None, budget_s=0):
+    # budget_s=0 disables the per-section wall budget by default so the
+    # schema tests stay timing-free; the timeout tests pass their own
     lines = []
     failed = bench.run_sections(
         sections=sections,
         only=only,
         emit_record=lambda rec: lines.append(json.dumps(rec)),
+        budget_s=budget_s,
     )
     return lines, failed
 
@@ -88,9 +91,45 @@ class TestSectionIsolation:
         assert names == sorted(set(names), key=names.index)  # unique
         for expected in (
             "alexnet_step", "lm_train", "lm_serve", "lm_serve_paged",
-            "lm_serve_prefix",
+            "lm_serve_prefix", "lm_serve_frontdoor",
         ):
             assert expected in names
+
+
+class TestSectionBudget:
+    def test_hung_section_times_out_and_round_continues(self):
+        # the PR 5 leftover named in ROADMAP: a section that never
+        # returns must emit its own timeout record and yield to the
+        # next section instead of stalling the round forever
+        import threading
+
+        def hung(ctx):
+            threading.Event().wait(timeout=30)  # "forever" at test scale
+            return [{"metric": "never", "value": 0, "unit": "u"}]
+
+        lines, failed = _collect(
+            [
+                _ok_section("before_rate", 1.0),
+                ("stuck", hung),
+                _ok_section("after_rate", 2.0),
+            ],
+            budget_s=0.3,
+        )
+        assert failed == ["stuck"]
+        recs = [json.loads(x) for x in lines]
+        assert [r.get("metric") for r in recs] == [
+            "before_rate", None, "after_rate",
+        ]
+        assert recs[1] == {
+            "error": "timeout", "section": "stuck", "budget_s": 0.3,
+        }
+
+    def test_fast_sections_are_untouched_by_the_budget(self):
+        lines, failed = _collect(
+            [_ok_section("quick_rate", 1.0)], budget_s=30.0
+        )
+        assert failed == []
+        assert json.loads(lines[0])["metric"] == "quick_rate"
 
 
 class TestBackendRetry:

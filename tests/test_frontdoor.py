@@ -1,0 +1,508 @@
+"""ServingFrontDoor: streaming, deadlines, cancel, shed, watchdog.
+
+The front door's contract (docs/SERVING.md "The front door"): every
+accepted request resolves to exactly one typed completion — eos/budget
+from the engine, cancelled / deadline_exceeded / error / shed from the
+robustness layer — with its stream terminated and, on the paged
+backend, its blocks reclaimed (free == pool after every scenario).  No
+failure path is theoretical here: each is forced deterministically via
+the :mod:`znicz_tpu.utils.faults` injection points and asserted
+against non-faulted ``generate()`` goldens for the survivors, plus the
+zero-new-compiled-programs invariant across watchdog restarts.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from znicz_tpu import observability as obs
+from znicz_tpu.core import prng
+from znicz_tpu.services import (
+    DecodeEngine,
+    EngineClosedError,
+    PagedDecodeEngine,
+    RejectedError,
+    RequestTooLargeError,
+    ServingFrontDoor,
+)
+from znicz_tpu.utils import faults
+from znicz_tpu.workflow import generate as G
+from znicz_tpu.workflow.transformer import init_lm_params
+
+EOS = 14
+HEADS = 4
+T_MAX = 64
+BS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def params():
+    prng.seed_all(27)
+    return init_lm_params(17, 32, 2, HEADS, max_seq=T_MAX)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm(params):
+    """Compile the engine programs ONCE before any timing-sensitive
+    test: the first-compile seconds must not eat a deadline budget."""
+    eng = _engine_factory(params)()
+    gen = np.random.default_rng(3)
+    for n in (5, 12):
+        eng.submit(gen.integers(0, 17, (n,)).astype(np.int32), 12)
+    eng.run()
+
+
+def _engine_factory(params, **kw):
+    kw.setdefault("n_heads", HEADS)
+    kw.setdefault("eos_id", EOS)
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("max_seq", T_MAX)
+    kw.setdefault("admit_every", 4)
+
+    def factory():
+        return PagedDecodeEngine(params, **kw)
+
+    return factory
+
+
+def _reference(params, prompt, budget, eos=EOS):
+    out = np.asarray(
+        G.generate(
+            params, jnp.asarray(prompt)[None], n_heads=HEADS,
+            max_new_tokens=budget, eos_id=eos,
+        )
+    )[0]
+    new = out[len(prompt):]
+    hit = np.where(new == eos)[0]
+    if len(hit):
+        new = new[: hit[0] + 1]
+    return np.concatenate([prompt, new])
+
+
+def _prompts(n, seed=7):
+    gen = np.random.default_rng(seed)
+    return [
+        gen.integers(0, 17, (k,)).astype(np.int32)
+        for k in (5, 12, 3, 9, 17)[:n]
+    ]
+
+
+def _long_prompt(params, budget=40, seed=21):
+    """A prompt whose greedy generation does NOT hit EOS within
+    ``budget`` — the deterministic victim for cancel/deadline/crash
+    tests (a natural EOS mid-test would win the race)."""
+    gen = np.random.default_rng(seed)
+    for _ in range(200):
+        p = gen.integers(0, 17, (6,)).astype(np.int32)
+        ref = _reference(params, p, budget)
+        if len(ref) - len(p) == budget and ref[-1] != EOS:
+            return p
+    raise AssertionError("no EOS-free prompt found in 200 draws")
+
+
+def _pool_swept(door):
+    st = door.engine.stats()
+    return st["pool_blocks_free"] == st["pool_blocks"]
+
+
+def _wait_until(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _labeled_sum(name):
+    m = obs.get_registry().metrics().get(name)
+    if m is None:
+        return 0.0
+    return sum(c.value for c in m.children().values())
+
+
+def _paged_compiles_total():
+    m = obs.get_registry().metrics().get("znicz_serve_compiles_total")
+    if m is None:
+        return 0.0
+    return sum(
+        c.value for key, c in m.children().items()
+        if key[0] in ("prefill", "paged_chunk", "cow")
+    )
+
+
+class TestStreaming:
+    def test_tokens_stream_and_goldens_match_generate(self, params):
+        prompts = _prompts(3)
+        budgets = [6, 4, 8]
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            handles = [
+                door.submit(p, b) for p, b in zip(prompts, budgets)
+            ]
+            streamed = [list(h.tokens(timeout=30.0)) for h in handles]
+            for h, p, b, toks in zip(handles, prompts, budgets, streamed):
+                comp = h.result(timeout=30.0)
+                assert comp.finish_reason in ("eos", "budget")
+                assert comp.trace_id == h.id
+                np.testing.assert_array_equal(
+                    comp.tokens, _reference(params, p, b)
+                )
+                # the stream is the completion's tail, token for token
+                assert toks == list(comp.tokens[len(p):])
+            assert len({h.id for h in handles}) == 3  # distinct trace ids
+            assert _pool_swept(door)
+            st = door.stats()
+            assert st["submitted"] == 3 and st["completed"] == 3
+
+    def test_dense_backend_works_too(self, params):
+        def factory():
+            return DecodeEngine(
+                params, n_heads=HEADS, eos_id=EOS, batch_size=2,
+                max_seq=T_MAX, admit_every=4,
+            )
+
+        prompts = _prompts(2)
+        with ServingFrontDoor(factory) as door:
+            handles = [door.submit(p, 5) for p in prompts]
+            for h, p in zip(handles, prompts):
+                comp = h.result(timeout=30.0)
+                np.testing.assert_array_equal(
+                    comp.tokens, _reference(params, p, 5)
+                )
+
+    def test_handle_result_timeout_raises(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params), engine_queue_limit=0
+        ) as door:
+            h = door.submit(_prompts(1)[0], 4)  # parked: nothing pumps
+            with pytest.raises(TimeoutError):
+                h.result(timeout=0.05)
+            with pytest.raises(TimeoutError):
+                next(h.tokens(timeout=0.05))
+
+
+class TestAdmission:
+    def test_validation_rejects_before_enqueue(self, params):
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            with pytest.raises(ValueError, match="empty prompt"):
+                door.submit([], 4)
+            with pytest.raises(RequestTooLargeError, match="paged"):
+                door.submit([1, 2, 3], 10_000)
+            # malformed prompt/deadline surface as ValueError at the
+            # caller — a str deadline must never reach the engine
+            # thread, where the per-tick expiry compare would wedge it
+            with pytest.raises(ValueError, match="malformed prompt"):
+                door.submit(None, 4)
+            with pytest.raises(ValueError, match="malformed prompt"):
+                door.submit([[1, 2], [3]], 4)
+            with pytest.raises(ValueError, match="malformed deadline"):
+                door.submit([1, 2], 4, deadline_s="soon")
+            with pytest.raises(ValueError, match="deadline_s >= 0"):
+                door.submit([1, 2], 4, deadline_s=-1.0)
+            # typed subclass keeps legacy except ValueError working
+            assert issubclass(RequestTooLargeError, ValueError)
+            assert door.stats()["submitted"] == 0  # nothing enqueued
+
+    def test_queue_full_sheds_with_retry_after(self, params):
+        before = _labeled_sum("znicz_serve_rejected_total")
+        with ServingFrontDoor(
+            _engine_factory(params), max_pending=2, engine_queue_limit=0
+        ) as door:
+            p = _prompts(1)[0]
+            door.submit(p, 4)
+            door.submit(p, 4)
+            with pytest.raises(RejectedError) as exc:
+                door.submit(p, 4)
+            assert exc.value.reason == "queue_full"
+            assert exc.value.retry_after_s > 0
+            assert door.stats()["rejected"] == {"queue_full": 1}
+        assert _labeled_sum("znicz_serve_rejected_total") > before
+
+    def test_pool_pressure_watermark_sheds(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params),
+            engine_queue_limit=0,
+            shed_pool_frac=2.0,  # every pool state is "under pressure"
+        ) as door:
+            p = _prompts(1)[0]
+            door.submit(p, 4)  # no backlog yet: accepted
+            with pytest.raises(RejectedError) as exc:
+                door.submit(p, 4)
+            assert exc.value.reason == "pool_pressure"
+
+
+class TestCancellation:
+    def test_cancel_before_admission(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params), engine_queue_limit=0
+        ) as door:
+            h = door.submit(_prompts(1)[0], 8)
+            assert h.cancel() is True
+            comp = h.result(timeout=10.0)
+            assert comp.finish_reason == "cancelled"
+            assert comp.n_new == 0
+            assert list(h.tokens(timeout=5.0)) == []  # stream terminated
+
+    def test_cancel_during_decode_reclaims_blocks(self, params):
+        pa = _long_prompt(params)  # EOS-free for the full 40 budget
+        pb = _prompts(2)[1]
+        # slow ticks: the 40-token victim needs >= 10 ticks x 50 ms,
+        # so the cancel deterministically lands mid-decode
+        faults.inject("frontdoor.slow_tick", delay=0.05)
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            ha = door.submit(pa, 40)  # long-running victim
+            hb = door.submit(pb, 5)  # unaffected neighbor
+            it = ha.tokens(timeout=30.0)
+            next(it)  # decoding for sure
+            assert ha.cancel() is True
+            comp = ha.result(timeout=30.0)
+            faults.clear()
+            assert comp.finish_reason == "cancelled"
+            assert 1 <= comp.n_new < 40
+            # the neighbor sharing the pool stays golden
+            np.testing.assert_array_equal(
+                hb.result(timeout=30.0).tokens, _reference(params, pb, 5)
+            )
+            _wait_until(
+                lambda: _pool_swept(door), what="block reclamation"
+            )
+            assert door.stats()["cancelled"] == 1
+
+    def test_cancel_after_completion_is_noop(self, params):
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            h = door.submit(_prompts(1)[0], 3)
+            h.result(timeout=30.0)
+            assert h.cancel() is False
+            assert door.stats()["cancelled"] == 0
+
+
+class TestDeadlines:
+    def test_deadline_expires_while_queued(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params), engine_queue_limit=0
+        ) as door:
+            h = door.submit(_prompts(1)[0], 8, deadline_s=0.01)
+            comp = h.result(timeout=10.0)
+            assert comp.finish_reason == "deadline_exceeded"
+            assert comp.n_new == 0
+
+    def test_deadline_expires_mid_decode(self, params):
+        # slow ticks make expiry deterministic: a 40-token budget needs
+        # ~10 ticks x >=50 ms >> the 250 ms deadline, and the first
+        # tick (admission + first chunk) lands well inside it
+        faults.inject("frontdoor.slow_tick", delay=0.05)
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            h = door.submit(_long_prompt(params), 40, deadline_s=0.25)
+            comp = h.result(timeout=30.0)
+            faults.clear()
+            assert comp.finish_reason == "deadline_exceeded"
+            assert 1 <= comp.n_new < 40  # expired MID-decode
+            _wait_until(
+                lambda: _pool_swept(door), what="block reclamation"
+            )
+            assert door.stats()["deadline_exceeded"] == 1
+
+    def test_default_deadline_applies(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params),
+            engine_queue_limit=0,
+            default_deadline_s=0.01,
+        ) as door:
+            comp = door.submit(_prompts(1)[0], 8).result(timeout=10.0)
+            assert comp.finish_reason == "deadline_exceeded"
+
+
+class TestWatchdog:
+    def test_engine_crash_fails_inflight_readmits_queued(self, params):
+        # batch_size=1: A occupies the slot, B sits in the ENGINE
+        # queue, C waits at the front door.  A decode-step crash must
+        # fail ONLY A (typed error), rebuild the engine, re-admit B and
+        # leave C untouched — both then golden-match generate() — and
+        # recompile NOTHING (the jit caches survive the restart).
+        pa = _long_prompt(params, budget=30)
+        pb, pc = _prompts(3)[1:]
+        factory = _engine_factory(params, batch_size=1, admit_every=2)
+        # slow ticks: A's 30-token budget spans >= 15 ticks x 50 ms, so
+        # the crash deterministically lands while A is still decoding
+        faults.inject("frontdoor.slow_tick", delay=0.05)
+        with ServingFrontDoor(factory, engine_queue_limit=1) as door:
+            ha = door.submit(pa, 30)
+            next(ha.tokens(timeout=30.0))  # A is decoding
+            hb = door.submit(pb, 5)
+            hc = door.submit(pc, 5)
+            _wait_until(
+                lambda: door.watchdog_state()["inflight"] == 2,
+                what="B pumped into the engine queue",
+            )
+            engine_before = door.engine
+            compiles_before = _paged_compiles_total()
+            faults.inject(
+                "engine.decode_step", exc=RuntimeError("boom"), times=1
+            )
+            ca = ha.result(timeout=30.0)
+            faults.clear("frontdoor.slow_tick")
+            assert ca.finish_reason == "error"
+            assert "boom" in ca.error
+            for h, p in ((hb, pb), (hc, pc)):
+                comp = h.result(timeout=60.0)
+                assert comp.finish_reason in ("eos", "budget")
+                np.testing.assert_array_equal(
+                    comp.tokens, _reference(params, p, 5)
+                )
+            st = door.stats()
+            assert st["watchdog_restarts"] == 1
+            assert door.engine is not engine_before
+            # watchdog restarts ride the warm jit caches: zero new
+            # compiled programs, pinned via znicz_serve_compiles_total
+            assert _paged_compiles_total() == compiles_before
+            assert _pool_swept(door)
+
+    def test_allocator_failure_is_survivable(self, params):
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            faults.inject(
+                "pool.alloc", exc=RuntimeError("alloc boom"), times=1
+            )
+            comp = door.submit(_prompts(1)[0], 4).result(timeout=30.0)
+            assert comp.finish_reason == "error"
+            assert "alloc boom" in comp.error
+            assert door.stats()["watchdog_restarts"] == 1
+            # the rebuilt engine serves normally
+            p = _prompts(2)[1]
+            comp2 = door.submit(p, 5).result(timeout=30.0)
+            np.testing.assert_array_equal(
+                comp2.tokens, _reference(params, p, 5)
+            )
+            assert _pool_swept(door)
+
+    def test_pool_exhaustion_expires_typed_then_recovers(self, params):
+        # persistent simulated exhaustion: allocation always reports
+        # the pool dry, so the request livelocks bind -> starve ->
+        # self-preempt until its DEADLINE retires it (typed, no hang,
+        # no leak); once pressure clears the door serves again
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            faults.inject("pool.pressure", flag=True)
+            comp = door.submit(
+                _prompts(1)[0], 4, deadline_s=0.3
+            ).result(timeout=30.0)
+            assert comp.finish_reason == "deadline_exceeded"
+            faults.clear()
+            p = _prompts(2)[1]
+            comp2 = door.submit(p, 5).result(timeout=30.0)
+            np.testing.assert_array_equal(
+                comp2.tokens, _reference(params, p, 5)
+            )
+            assert _pool_swept(door)
+            assert door.stats()["watchdog_restarts"] == 0  # no crash
+
+    def test_stall_detection_flips_health(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params), stall_after_s=0.1
+        ) as door:
+            assert door.healthy()
+            faults.inject("frontdoor.slow_tick", delay=0.6, times=1)
+            _wait_until(
+                lambda: door.watchdog_state()["state"] == "stalled",
+                timeout=5.0,
+                what="stall detection",
+            )
+            assert not door.healthy()
+            _wait_until(
+                lambda: door.watchdog_state()["state"] == "running",
+                timeout=5.0,
+                what="stall recovery",
+            )
+
+
+class TestShutdown:
+    def test_close_drains_then_sheds_with_typed_completions(self, params):
+        door = ServingFrontDoor(
+            _engine_factory(params), engine_queue_limit=0
+        )
+        h1 = door.submit(_prompts(1)[0], 4)
+        h2 = door.submit(_prompts(1)[0], 4)
+        door.close(grace_s=0.1)  # parked work cannot drain: shed
+        for h in (h1, h2):
+            comp = h.result(timeout=5.0)
+            assert comp.finish_reason == "shed"
+            assert list(h.tokens(timeout=2.0)) == []
+        assert door.stats()["shed"] == 2
+        with pytest.raises(EngineClosedError):
+            door.submit(_prompts(1)[0], 4)
+        assert door.watchdog_state()["state"] == "closed"
+
+    def test_close_is_idempotent_and_drains_live_work(self, params):
+        door = ServingFrontDoor(_engine_factory(params))
+        p = _prompts(1)[0]
+        h = door.submit(p, 5)
+        door.close(grace_s=30.0)
+        comp = h.result(timeout=5.0)
+        np.testing.assert_array_equal(
+            comp.tokens, _reference(params, p, 5)
+        )
+        door.close()  # second close is a no-op
+
+
+class TestCompileBudget:
+    def test_frontdoor_adds_zero_compiled_programs(self, params):
+        # twin streams: once through a bare engine, once through the
+        # front door — the registry's first-compile ledger must not
+        # move for the front-door run (it reuses the same prefill /
+        # decode-chunk programs; deadline/cancel/watchdog machinery is
+        # host-side only)
+        prompts, budgets = _prompts(3), [6, 4, 8]
+        eng = _engine_factory(params)()
+        for p, b in zip(prompts, budgets):
+            eng.submit(p, b)
+        eng.run()
+        before = _paged_compiles_total()
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            handles = [
+                door.submit(p, b) for p, b in zip(prompts, budgets)
+            ]
+            for h in handles:
+                h.result(timeout=30.0)
+            ledger = door.engine.compile_stats()["programs"]
+        assert _paged_compiles_total() == before
+        assert {k[0] for k in ledger} <= {"prefill", "paged_chunk", "cow"}
+
+
+class TestFaultsHarness:
+    def test_times_bounds_fires(self):
+        faults.inject("x.y", exc=RuntimeError("q"), times=2)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                faults.fire("x.y")
+        assert faults.fire("x.y") is False  # auto-disarmed
+
+    def test_flag_and_delay_points(self):
+        faults.inject("p.q", flag=True)
+        assert faults.fire("p.q") is True
+        faults.clear("p.q")
+        assert faults.fire("p.q") is False
+        t0 = time.monotonic()
+        faults.inject("s.t", delay=0.05, times=1)
+        assert faults.fire("s.t") is True
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_injected_scope_clears_even_on_raise(self):
+        with pytest.raises(faults.FaultInjected):
+            with faults.injected("a.b", times=5):
+                faults.fire("a.b")
+        assert faults.fire("a.b") is False
+
+    def test_env_spec_parses_and_rejects_garbage(self):
+        faults._parse_env("m.n:times=1:delay=0.0,o.p:flag")
+        assert faults.armed("m.n") and faults.fire("o.p") is True
+        faults.clear()
+        with pytest.raises(ValueError, match="unknown field"):
+            faults._parse_env("q.r:bogus=1")

@@ -1,17 +1,30 @@
-"""Services tests: plotting, CSV metrics, image saver, status writer."""
+"""Services tests: plotting, CSV metrics, image saver, status writer,
+and the HTTP serving front door (streaming / shed-503 / healthz /
+graceful shutdown)."""
 
+import http.client
 import json
 import os
+import threading
+import time
+
+import numpy as np
+import pytest
 
 from znicz_tpu.core import prng
 from znicz_tpu.loader import datasets
 from znicz_tpu.services import (
     AccumulatingPlotter,
+    EngineClosedError,
     ImageSaver,
     MetricsCSVWriter,
+    PagedDecodeEngine,
+    ServingFrontDoor,
     StatusWriter,
     Weights2D,
 )
+from znicz_tpu.services import serve as serve_mod
+from znicz_tpu.utils import faults
 from znicz_tpu.workflow import StandardWorkflow
 
 MLP_LAYERS = [
@@ -138,3 +151,232 @@ def test_service_failure_does_not_kill_training(tmp_path):
     wf = _wf(tmp_path, [Broken()])
     dec = wf.run()  # must complete despite the failing service
     assert dec.epoch == 2
+
+
+# -- the HTTP serving front door ------------------------------------------
+
+EOS, HEADS, T_MAX = 14, 4, 64
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    from znicz_tpu.workflow.transformer import init_lm_params
+
+    prng.seed_all(27)
+    return init_lm_params(17, 32, 2, HEADS, max_seq=T_MAX)
+
+
+@pytest.fixture()
+def http_door(lm_params, request):
+    """A front door + live HTTP server on an ephemeral port; torn down
+    whatever the test does."""
+    faults.clear()
+    kw = getattr(request, "param", {})
+
+    def factory():
+        return PagedDecodeEngine(
+            lm_params, n_heads=HEADS, eos_id=EOS, batch_size=2,
+            block_size=8, max_seq=T_MAX, admit_every=4,
+        )
+
+    door = ServingFrontDoor(factory, **kw)
+    server = serve_mod.build_server(directory=".", port=0, frontdoor=door)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield door, port
+    finally:
+        faults.clear()
+        serve_mod.shutdown_gracefully(server, door, grace_s=2.0)
+
+
+def _post(port, body, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/generate", body=json.dumps(body))
+    return conn, conn.getresponse()
+
+
+def _read_ndjson(resp):
+    lines = []
+    while True:
+        line = resp.readline()
+        if not line:
+            return lines
+        lines.append(json.loads(line))
+
+
+def test_generate_streams_tokens_and_typed_done_record(lm_params, http_door):
+    import jax.numpy as jnp
+
+    from znicz_tpu.workflow import generate as G
+
+    door, port = http_door
+    prompt = [1, 2, 3, 4, 5]
+    conn, resp = _post(port, {"prompt": prompt, "max_new_tokens": 6})
+    assert resp.status == 200
+    trace = resp.getheader("X-Znicz-Trace-Id")
+    assert trace  # client-visible trace id rides the response header
+    lines = _read_ndjson(resp)
+    conn.close()
+    done = lines[-1]
+    assert done["done"] is True and done["trace_id"] == trace
+    assert done["finish_reason"] in ("eos", "budget")
+    streamed = [rec["token"] for rec in lines[:-1]]
+    assert len(streamed) == done["n_new"]
+    ref = np.asarray(
+        G.generate(
+            lm_params, jnp.asarray(prompt, jnp.int32)[None],
+            n_heads=HEADS, max_new_tokens=6, eos_id=EOS,
+        )
+    )[0][len(prompt):]
+    hit = np.where(ref == EOS)[0]
+    if len(hit):
+        ref = ref[: hit[0] + 1]
+    assert streamed == list(ref)
+
+
+@pytest.mark.parametrize(
+    "http_door", [{"max_pending": 1, "engine_queue_limit": 0}],
+    indirect=True,
+)
+def test_generate_sheds_503_with_retry_after(http_door):
+    door, port = http_door
+    c1, r1 = _post(port, {"prompt": [1, 2], "max_new_tokens": 4})
+    # engine_queue_limit=0 parks the first request, filling the queue;
+    # the second must shed with 503 + Retry-After, not wait
+    c2, r2 = _post(port, {"prompt": [1, 2], "max_new_tokens": 4})
+    assert r2.status == 503
+    assert int(r2.getheader("Retry-After")) >= 1
+    body = json.loads(r2.read())
+    assert body["error"] == "rejected" and body["reason"] == "queue_full"
+    c2.close()
+    c1.close()
+
+
+def test_generate_rejects_bad_and_oversized_requests(http_door):
+    _, port = http_door
+    c, r = _post(port, {"max_new_tokens": 4})  # no prompt
+    assert r.status == 400
+    assert json.loads(r.read())["error"] == "bad_request"
+    c.close()
+    c, r = _post(port, {"prompt": [1, 2], "max_new_tokens": 100_000})
+    assert r.status == 400
+    assert json.loads(r.read())["error"] == "request_too_large"
+    c.close()
+    # malformed payloads must answer 400, never crash the engine
+    # thread (a str deadline) or drop the connection (a None prompt)
+    for bad in (
+        {"prompt": [1, 2], "max_new_tokens": 4, "deadline_s": "soon"},
+        {"prompt": None, "max_new_tokens": 4},
+        {"prompt": [[1, 2], [3]], "max_new_tokens": 4},
+    ):
+        c, r = _post(port, bad)
+        assert r.status == 400, bad
+        assert json.loads(r.read())["error"] == "bad_request"
+        c.close()
+    # a NUMERIC string deadline is coerced, not rejected
+    c, r = _post(
+        port, {"prompt": [1, 2], "max_new_tokens": 2, "deadline_s": "30"}
+    )
+    assert r.status == 200
+    assert _read_ndjson(r)[-1]["done"] is True
+    c.close()
+
+
+def _eos_free_prompt(params, budget=40):
+    """A prompt whose greedy generation never hits EOS inside
+    ``budget`` — a natural EOS would end the stream before the
+    disconnect is noticed."""
+    import jax.numpy as jnp
+
+    from znicz_tpu.workflow import generate as G
+
+    gen = np.random.default_rng(21)
+    for _ in range(200):
+        p = gen.integers(0, 17, (6,)).astype(np.int32)
+        out = np.asarray(
+            G.generate(
+                params, jnp.asarray(p)[None], n_heads=HEADS,
+                max_new_tokens=budget, eos_id=EOS,
+            )
+        )[0][len(p):]
+        if EOS not in out:
+            return p.tolist()
+    raise AssertionError("no EOS-free prompt found in 200 draws")
+
+
+def test_client_disconnect_cancels_request(lm_params, http_door):
+    import socket
+
+    door, port = http_door
+    prompt = _eos_free_prompt(lm_params)
+    # slow ticks keep the 40-token request running while we vanish
+    faults.inject("frontdoor.slow_tick", delay=0.05)
+    conn, resp = _post(port, {"prompt": prompt, "max_new_tokens": 40})
+    resp.readline()  # at least one streamed token
+    # the caller crashes mid-stream: SHUT_RDWR actually tears the
+    # connection down (a plain close() keeps the fd alive under the
+    # response's buffered reader)
+    conn.sock.shutdown(socket.SHUT_RDWR)
+    conn.sock.close()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if door.stats()["cancelled"] == 1:
+            break
+        time.sleep(0.05)
+    faults.clear()
+    assert door.stats()["cancelled"] == 1  # blocks reclaimed, not pinned
+
+
+def test_healthz_tracks_watchdog_state(http_door):
+    door, port = http_door
+
+    def healthz():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        return resp.status, body
+
+    status, body = healthz()
+    assert status == 200 and body["state"] == "running"
+    door.close(grace_s=0.5)
+    status, body = healthz()
+    assert status == 503 and body["state"] == "closed"
+
+
+def test_healthz_without_frontdoor_is_plain_ok(tmp_path):
+    server = serve_mod.build_server(directory=str(tmp_path), port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200 and resp.read() == b"ok\n"
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_graceful_shutdown_drains_and_closes(lm_params):
+    def factory():
+        return PagedDecodeEngine(
+            lm_params, n_heads=HEADS, eos_id=EOS, batch_size=2,
+            block_size=8, max_seq=T_MAX, admit_every=4,
+        )
+
+    door = ServingFrontDoor(factory)
+    server = serve_mod.build_server(directory=".", port=0, frontdoor=door)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    conn, resp = _post(port, {"prompt": [1, 2, 3], "max_new_tokens": 5})
+    serve_mod.shutdown_gracefully(server, door, grace_s=10.0)
+    # the in-flight stream DRAINED (typed done record), intake closed
+    lines = _read_ndjson(resp)
+    conn.close()
+    assert lines[-1]["done"] is True
+    assert lines[-1]["finish_reason"] in ("eos", "budget")
+    with pytest.raises(EngineClosedError):
+        door.submit([1, 2], 4)

@@ -49,6 +49,7 @@ def get_rules(
 
 # importing the modules performs registration
 from znicz_tpu.analysis.rules import (  # noqa: E402,F401
+    blocking,
     donation,
     exceptions,
     host_effects,

@@ -1,0 +1,85 @@
+"""ZNC010: unbounded blocking primitives in ``services/``.
+
+The serving stack's contract is "no hung clients, ever"
+(docs/SERVING.md): every wait the front door, the HTTP layer, or the
+engine thread performs must be BOUNDED, because a missing timeout turns
+any dropped wake-up, dead peer, or wedged thread into a silent
+permanent hang — the exact failure the watchdog exists to catch.  This
+rule flags the stdlib blocking calls that default to "wait forever"
+when they appear in a ``services/`` module with no ``timeout``:
+
+* ``queue.Queue.get()`` (``.get_nowait()`` / ``.get(timeout=...)`` /
+  ``.get(block=False)`` are fine)
+* ``threading.Event.wait()`` / ``Condition.wait()``
+* ``Thread.join()``
+* ``Lock.acquire()`` (``acquire(False)`` / ``acquire(blocking=False)``
+  / ``acquire(timeout=...)`` are fine)
+
+Detection is conservative to stay quiet on the common non-blocking
+homonyms: a call fires only when it is an ATTRIBUTE call with ZERO
+positional arguments and none of the ``timeout`` / ``block`` /
+``blocking`` keywords — so ``", ".join(parts)``, ``d.get(key)``,
+``lock.acquire(False)`` and ``t.join(grace)`` never fire — and only in
+modules under a ``services/`` path (hot training-loop code is free to
+block on purpose; the serving tier is not).  Attribute chains that
+resolve to an imported MODULE (``os.wait()``) are skipped: the rule
+targets object-level synchronization primitives.
+
+A deliberate unbounded wait (rare; say why) is exempted inline with
+``# znicz-check: disable=ZNC010 -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from znicz_tpu.analysis.rules import Rule, register
+
+_BLOCKING_METHODS = ("get", "wait", "join", "acquire")
+_ESCAPE_KEYWORDS = ("timeout", "block", "blocking")
+
+
+@register
+class UnboundedBlockingRule(Rule):
+    id = "ZNC010"
+    severity = "warning"
+    title = (
+        "unbounded blocking call in services/ (pass a timeout: a "
+        "missing one turns a lost wake-up into a permanent hang)"
+    )
+
+    def _in_services(self, info) -> bool:
+        path = info.path.replace("\\", "/")
+        return "/services/" in f"/{path}"
+
+    def check(self, info) -> Iterable:
+        if not self._in_services(info):
+            return
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_METHODS
+            ):
+                continue
+            if node.args:
+                continue  # ", ".join(parts), d.get(k), acquire(False)
+            if any(kw.arg in _ESCAPE_KEYWORDS for kw in node.keywords):
+                continue
+            # module-level functions (os.wait(), loader.join()) are not
+            # synchronization objects — skip resolvable module bases
+            base = node.func.value
+            if isinstance(base, ast.Name) and (
+                base.id in info.import_aliases
+                or base.id in info.from_imports
+            ):
+                continue
+            yield self.finding(
+                info,
+                node,
+                f".{node.func.attr}() with no timeout blocks forever "
+                "if the wake-up never comes; pass timeout= (loop if "
+                "the wait is logically unbounded) or pragma-exempt "
+                "with a reason",
+            )

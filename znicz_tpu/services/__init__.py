@@ -18,6 +18,16 @@ from znicz_tpu.services.engine import (  # noqa: F401
     DecodeEngine,
     PagedDecodeEngine,
 )
+from znicz_tpu.services.errors import (  # noqa: F401
+    EngineClosedError,
+    RejectedError,
+    RequestTooLargeError,
+    retryable,
+)
+from znicz_tpu.services.frontdoor import (  # noqa: F401
+    RequestHandle,
+    ServingFrontDoor,
+)
 from znicz_tpu.services.image_saver import ImageSaver  # noqa: F401
 from znicz_tpu.services.publishing import MarkdownReporter  # noqa: F401
 from znicz_tpu.services.web_status import StatusWriter  # noqa: F401

@@ -60,7 +60,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu import observability
-from znicz_tpu.utils import profiling
+from znicz_tpu.services.errors import RequestTooLargeError
+from znicz_tpu.utils import faults, profiling
 from znicz_tpu.workflow.generate import (
     DEFAULT_PROMPT_BUCKETS,
     NULL_BLOCK,
@@ -111,16 +112,27 @@ class Completion:
     """A finished request: prompt + generated tokens plus its serving
     metrics.  ``latency_s`` is submit -> retirement (queue wait
     included — the number a caller actually experiences); ``ttft_s`` is
-    submit -> first sampled token."""
+    submit -> first sampled token.
+
+    ``finish_reason`` is the full failure taxonomy (docs/SERVING.md):
+    ``"eos"`` / ``"budget"`` from the engine itself, plus the typed
+    terminations the front door retires with — ``"cancelled"``,
+    ``"deadline_exceeded"``, ``"error"`` (engine-thread failure;
+    ``error`` carries the message) and ``"shed"`` (dropped at
+    shutdown).  ``trace_id`` is the client-visible request id when the
+    request came through a :class:`~znicz_tpu.services.frontdoor
+    .ServingFrontDoor`."""
 
     id: int
     tokens: np.ndarray  # prompt + generated, EOS included when hit
     n_new: int
-    finish_reason: str  # "eos" | "budget"
+    finish_reason: str  # "eos" | "budget" | typed front-door reasons
     latency_s: float
     tokens_per_sec: float
     bucket: int
     ttft_s: Optional[float] = None
+    error: Optional[str] = None  # set for finish_reason == "error"
+    trace_id: Optional[str] = None  # front-door request id
 
 
 def _sample_tok(logits, key, temperature, top_p, *, greedy, top_k, nucleus):
@@ -508,7 +520,7 @@ class DecodeEngine:
         ``t_max`` window here, the block pool in the paged subclass."""
         bucket = bucket_for(p.size, self.prompt_buckets)
         if bucket + max_new_tokens > self.t_max:
-            raise ValueError(
+            raise RequestTooLargeError(
                 f"prompt bucket {bucket} (len {p.size}) + max_new_tokens "
                 f"{max_new_tokens} exceeds the dense KV buffer "
                 f"(t_max={self.t_max})"
@@ -629,6 +641,7 @@ class DecodeEngine:
             self._remaining[slot] = req.max_new_tokens - 1
 
     def _run_chunk(self) -> None:
+        faults.fire("engine.decode_step")
         self._peak_active = max(self._peak_active, self.active)
         with self.timer.phase("decode", active=self.active):
             rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
@@ -701,6 +714,51 @@ class DecodeEngine:
         self._total_new += len(emitted)
         self._m_retired.labels(reason=reason).inc()
         self._m_tokens.inc(len(emitted))
+
+    # -- out-of-band retirement (cancellation / deadlines) ----------------
+
+    def abort(self, request_id: int, reason: str) -> Optional[Completion]:
+        """Retire a request OUT OF BAND with a typed completion —
+        cancellation or deadline expiry, driven by the front door
+        between ticks.  Works wherever the request currently lives:
+        still queued (removed, zero tokens) or occupying a slot
+        (tokens emitted so far are kept; the slot — and on the paged
+        backend its blocks — is reclaimed immediately).  Returns the
+        typed :class:`Completion`, or None when the id is unknown or
+        already completed (the normal completion wins the race).
+
+        NOT thread-safe: call only from the thread that drives the
+        engine (the front door's engine thread)."""
+        for i, req in enumerate(self._queue):
+            if req.id == request_id:
+                del self._queue[i]
+                self._m_queue_depth.set(len(self._queue))
+                self._retire(req, [], reason)
+                return self.completions[request_id]
+        for slot, st in enumerate(self._slots):
+            if st is not None and st["req"].id == request_id:
+                self._abort_slot(slot, reason)
+                return self.completions[request_id]
+        return None
+
+    def reap(self, request_id: int) -> None:
+        """Forget a completed request's record.  The front door copies
+        each completion into its own handle as it collects it — keeping
+        the engine-side ``completions``/retirement-order ledgers for
+        every request ever served would leak on a long-lived service.
+        Batch-style callers that use :meth:`run` never need this."""
+        if self.completions.pop(request_id, None) is not None:
+            self._order = [c for c in self._order if c.id != request_id]
+
+    def _abort_slot(self, slot: int, reason: str) -> None:
+        """Dense out-of-band slot retirement: the slot just empties —
+        its stale K/V is rebuilt from a zeroed row at re-admission."""
+        st = self._slots[slot]
+        self._retire(st["req"], list(st.get("emitted") or []), reason)
+        self._slots[slot] = None
+        self._done[slot] = True
+        self._remaining[slot] = 0
+        self._m_active.set(self.active)
 
     # -- introspection ----------------------------------------------------
 
@@ -939,13 +997,13 @@ class PagedDecodeEngine(DecodeEngine):
         total = padded + max_new_tokens
         need = -(-total // self.block_size)
         if total > self.t_max:
-            raise ValueError(
+            raise RequestTooLargeError(
                 f"prompt (len {p.size}, padded {padded}) + max_new_tokens "
                 f"{max_new_tokens} exceeds the paged backend's positional "
                 f"window (t_max={self.t_max})"
             )
         if need > self.usable_blocks:
-            raise ValueError(
+            raise RequestTooLargeError(
                 f"prompt (len {p.size}, padded {padded}) + max_new_tokens "
                 f"{max_new_tokens} needs {need} KV blocks; exceeds the "
                 f"paged KV pool ({self.usable_blocks} usable blocks x "
@@ -998,6 +1056,9 @@ class PagedDecodeEngine(DecodeEngine):
         EVICT the least-recently-used cache-only block — the cache
         always yields before any live request is preempted.  Returns
         -1 when both are dry (the caller preempts)."""
+        faults.fire("pool.alloc")  # injected allocator failure (raises)
+        if faults.fire("pool.pressure"):
+            return -1  # injected exhaustion: free list AND cache "dry"
         if self._free:
             return self._free.pop()
         if self._lru:
@@ -1306,6 +1367,7 @@ class PagedDecodeEngine(DecodeEngine):
         st = self._slots[slot]
         if st is None or st["mode"] != "prefill":
             return False  # preempted mid-tick, or already decoding
+        faults.fire("engine.prefill")
         req = st["req"]
         size = req.prompt.size
         c = st["chunks_done"]
@@ -1392,6 +1454,16 @@ class PagedDecodeEngine(DecodeEngine):
         self._pos[slot] = 0
         self._start[slot] = 0
 
+    def _abort_slot(self, slot: int, reason: str) -> None:
+        """Paged out-of-band retirement rides the normal retire hook:
+        completed full blocks publish to the prefix cache (their K/V is
+        valid — a cancelled request's prefix is still reusable) and
+        every table reference is released, so the blocks are
+        reclaimable the moment the typed completion exists."""
+        st = self._slots[slot]
+        self._retire_slot(slot, list(st.get("emitted") or []), reason)
+        self._m_active.set(self.active)
+
     # -- the serving loop -------------------------------------------------
 
     @property
@@ -1412,6 +1484,7 @@ class PagedDecodeEngine(DecodeEngine):
         return bool(self._queue) or self.active > 0 or self.prefilling > 0
 
     def _run_chunk(self) -> None:
+        faults.fire("engine.decode_step")
         # lazy per-chunk allocation, oldest first: each decoding row
         # gets blocks covering the positions THIS chunk can write
         # (min(chunk, remaining) steps) — never the whole budget up
@@ -1523,6 +1596,15 @@ class PagedDecodeEngine(DecodeEngine):
             "paged_chunk_jit_entries": _paged_decode_chunk._cache_size(),
             "cow_jit_entries": _cow_copy_prog._cache_size(),
         }
+
+    @property
+    def pool_free_frac(self) -> float:
+        """Fraction of the pool still ALLOCATABLE (free list plus
+        evictable cache-only blocks) — the one owner of the formula the
+        front door's pool-pressure watermark reads."""
+        return (len(self._free) + len(self._lru)) / max(
+            self.usable_blocks, 1
+        )
 
     def stats(self) -> Dict:
         """Adds the block-pool + prefix-cache view to the base report.

@@ -18,6 +18,25 @@ Endpoints beyond the static files:
   file-first preference (the ``"metrics"`` snapshot StatusWriter embeds
   in ``status.json``), so the two endpoints never contradict each
   other.
+* ``/healthz`` — liveness.  Plain 200 for a static status server; when
+  a :class:`~znicz_tpu.services.frontdoor.ServingFrontDoor` is attached
+  (:func:`build_server`), 200 only while its watchdog reports
+  ``running`` — a stalled tick, a failed engine rebuild, or a closed
+  door answer 503, so a load balancer stops routing here before
+  clients hang.
+* ``POST /generate`` — LM serving through the front door: a JSON body
+  ``{"prompt": [ids], "max_new_tokens": N, "deadline_s": S?}`` streams
+  back newline-delimited JSON (chunked transfer): one ``{"token": t}``
+  line per generated token and a final ``{"done": true, ...}`` record
+  carrying the typed ``finish_reason``, the client-visible trace id
+  (also in the ``X-Znicz-Trace-Id`` response header) and latency.
+  Load shedding answers 503 + ``Retry-After``; an impossible request
+  400.  A client that disconnects mid-stream gets its request
+  CANCELLED — crashed callers cannot pin KV blocks.
+
+Graceful shutdown: :func:`run_server` installs SIGTERM/SIGINT handlers
+that drain the front door up to a grace period, shed the rest with
+typed rejections, stop the listener, and exit 0.
 """
 
 from __future__ import annotations
@@ -26,14 +45,24 @@ import functools
 import http.server
 import json
 import logging
+import math
 import os
+import signal
 import sys
+import threading
 
 from znicz_tpu.observability import get_registry, parse_prometheus_text
+from znicz_tpu.services.errors import (
+    EngineClosedError,
+    RejectedError,
+    RequestTooLargeError,
+    retryable,
+)
 
 logger = logging.getLogger(__name__)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+NDJSON_CONTENT_TYPE = "application/x-ndjson"
 
 
 def _snapshot_from_prom(text: str) -> dict:
@@ -54,11 +83,25 @@ def _snapshot_from_prom(text: str) -> dict:
 
 
 class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
-    """Static status files + the registry export endpoints."""
+    """Static status files + registry export + the serving front door.
+
+    HTTP/1.1 so ``POST /generate`` can stream chunked responses; every
+    non-streaming response therefore carries an explicit
+    Content-Length (``_send``)."""
+
+    protocol_version = "HTTP/1.1"
+
+    def __init__(self, *args, frontdoor=None, **kwargs):
+        # set BEFORE super().__init__: BaseHTTPRequestHandler handles
+        # the request inside its constructor
+        self.frontdoor = frontdoor
+        super().__init__(*args, **kwargs)
 
     def do_GET(self):  # noqa: N802 — http.server API
         path = self.path.split("?", 1)[0]
-        if path == "/metrics":
+        if path == "/healthz":
+            self._do_healthz()
+        elif path == "/metrics":
             prom = os.path.join(self.directory, "metrics.prom")
             if os.path.exists(prom):
                 with open(prom, "rb") as f:
@@ -102,12 +145,203 @@ class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
                                prom_path)
         return None
 
-    def _send(self, body: bytes, content_type: str) -> None:
+    def _do_healthz(self) -> None:
+        fd = self.frontdoor
+        if fd is None:
+            self._send(b"ok\n", "text/plain")
+            return
+        state = fd.watchdog_state()
+        body = (json.dumps(state) + "\n").encode()
+        self._send(
+            body,
+            "application/json",
+            status=200 if state["state"] == "running" else 503,
+        )
+
+    # -- the serving front door -------------------------------------------
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path != "/generate":
+            self.send_error(404, "unknown endpoint")
+            return
+        fd = self.frontdoor
+        if fd is None:
+            self._send_json(
+                {"error": "no_engine",
+                 "detail": "this server has no serving front door attached"},
+                status=503,
+            )
+            return
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = body["prompt"]
+            max_new = int(body.get("max_new_tokens", 16))
+            deadline_s = body.get("deadline_s")
+            if deadline_s is not None:
+                deadline_s = float(deadline_s)
+        except (KeyError, TypeError, ValueError) as exc:
+            self._send_json(
+                {"error": "bad_request", "detail": str(exc)}, status=400
+            )
+            return
+        try:
+            handle = fd.submit(
+                prompt, max_new, deadline_s=deadline_s
+            )
+        except RejectedError as exc:
+            self._send_json(
+                {"error": "rejected", "reason": exc.reason,
+                 "detail": str(exc)},
+                status=503,
+                headers={
+                    "Retry-After": str(
+                        max(int(math.ceil(retryable(exc) or 1.0)), 1)
+                    )
+                },
+            )
+            return
+        except EngineClosedError as exc:
+            self._send_json(
+                {"error": "engine_closed", "detail": str(exc)},
+                status=503,
+                headers={
+                    "Retry-After": str(
+                        max(int(math.ceil(retryable(exc) or 1.0)), 1)
+                    )
+                },
+            )
+            return
+        except RequestTooLargeError as exc:
+            self._send_json(
+                {"error": "request_too_large", "detail": str(exc)},
+                status=400,
+            )
+            return
+        except (TypeError, ValueError) as exc:
+            # malformed prompt (None, ragged/nested lists, non-ints)
+            # surfaces from submit()'s array coercion — a client error,
+            # never a dropped connection
+            self._send_json(
+                {"error": "bad_request", "detail": str(exc)}, status=400
+            )
+            return
+        self._stream_generation(fd, handle)
+
+    def _stream_generation(self, fd, handle) -> None:
+        """Chunked NDJSON token stream; a broken pipe mid-stream
+        cancels the request so abandoned work frees its KV blocks."""
         self.send_response(200)
+        self.send_header("Content-Type", NDJSON_CONTENT_TYPE)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Znicz-Trace-Id", handle.id)
+        self.end_headers()
+        try:
+            for tok in handle.tokens():
+                self._chunk({"token": int(tok)})
+            comp = handle.result(timeout=30.0)
+            self._chunk(
+                {
+                    "done": True,
+                    "trace_id": handle.id,
+                    "finish_reason": comp.finish_reason,
+                    "n_new": comp.n_new,
+                    "latency_ms": round(1000.0 * comp.latency_s, 1),
+                    **(
+                        {"error": comp.error}
+                        if comp.error is not None
+                        else {}
+                    ),
+                }
+            )
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            logger.warning(
+                "client gone mid-stream; cancelling %s", handle.id
+            )
+            fd.cancel(handle.id)
+
+    def _chunk(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _send_json(self, obj: dict, status: int = 200, headers=None):
+        self._send(
+            (json.dumps(obj) + "\n").encode(),
+            "application/json",
+            status=status,
+            headers=headers,
+        )
+
+    def _send(
+        self,
+        body: bytes,
+        content_type: str,
+        status: int = 200,
+        headers=None,
+    ) -> None:
+        self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
+
+
+def build_server(
+    directory: str = ".",
+    port: int = 8080,
+    host: str = "127.0.0.1",
+    frontdoor=None,
+) -> http.server.ThreadingHTTPServer:
+    """A ready-to-serve HTTP server; ``port=0`` binds an ephemeral
+    port (read it back from ``server.server_address``).  Pass a
+    :class:`~znicz_tpu.services.frontdoor.ServingFrontDoor` to enable
+    ``POST /generate`` and watchdog-backed ``/healthz``."""
+    handler = functools.partial(
+        StatusRequestHandler, directory=directory, frontdoor=frontdoor
+    )
+    return http.server.ThreadingHTTPServer((host, port), handler)
+
+
+def shutdown_gracefully(server, frontdoor=None, grace_s: float = 5.0):
+    """Drain-then-stop, callable from any thread: the front door stops
+    intake, drains in-flight requests up to ``grace_s``, sheds the
+    remainder with typed rejections, then the listener stops.  Running
+    response threads are daemonic (``ThreadingHTTPServer``), and every
+    front-door stream has already been resolved by ``close()`` — so
+    shutdown cannot hang on a slow client."""
+    if frontdoor is not None:
+        frontdoor.close(drain=True, grace_s=grace_s)
+    server.shutdown()
+
+
+def run_server(server, frontdoor=None, grace_s: float = 5.0) -> int:
+    """Serve until SIGTERM/SIGINT, then shut down gracefully and
+    return 0 (the exit code a process supervisor reads as a clean
+    rollout, not a crash)."""
+
+    def _on_signal(signum, frame):
+        logger.info(
+            "signal %s: graceful shutdown (grace %.1fs)", signum, grace_s
+        )
+        # serve_forever() must keep running while we drain — shutdown()
+        # blocks until the serve loop exits, so do it off-thread
+        threading.Thread(
+            target=shutdown_gracefully,
+            args=(server, frontdoor, grace_s),
+            name="graceful-shutdown",
+            daemon=True,
+        ).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
+    server.serve_forever()
+    server.server_close()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -117,13 +351,12 @@ def main(argv=None) -> int:
     directory = args[0] if args else "."
     port = int(args[1]) if len(args) > 1 else 8080
     host = args[2] if len(args) > 2 else "127.0.0.1"
-    handler = functools.partial(StatusRequestHandler, directory=directory)
+    server = build_server(directory, port, host)
     print(
         f"serving {directory} at http://{host}:{port}/status.html "
-        f"(metrics at /metrics)"
+        f"(metrics at /metrics, liveness at /healthz)"
     )
-    http.server.ThreadingHTTPServer((host, port), handler).serve_forever()
-    return 0
+    return run_server(server)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,187 @@
+"""Fault injection: named failure points, toggled per-test or per-env.
+
+The serving stack's failure handling (docs/SERVING.md "The front
+door") is only trustworthy if every failure path actually RUNS in CI —
+"the allocator could fail" is a theory until a test makes it fail and
+asserts what the engine does next.  This module gives the engine and
+the front door named injection points that are zero-cost no-ops in
+production and deterministic failures under test:
+
+========================  ==================================================
+point                     effect when armed
+========================  ==================================================
+``engine.decode_step``    raises before the decode-chunk program runs (an
+                          engine-thread crash: the watchdog-restart path)
+``engine.prefill``        raises before a paged prefill chunk runs
+``pool.alloc``            raises inside the block allocator (allocator
+                          failure mid-tick)
+``pool.pressure``         behavioral: the allocator reports the pool dry
+                          (free list AND cache) — the eviction/preemption/
+                          shedding ladder without filling real memory
+``frontdoor.slow_tick``   sleeps at the top of the engine-thread tick (a
+                          stalled tick: the watchdog-detection path)
+========================  ==================================================
+
+Arming::
+
+    with faults.injected("engine.decode_step", exc=RuntimeError("boom"),
+                         times=1):
+        ...          # exactly one decode chunk raises, then disarmed
+
+    faults.inject("pool.pressure", flag=True)   # until faults.clear()
+    faults.inject("frontdoor.slow_tick", delay=0.05)
+
+or from the environment (process-wide, e.g. a chaos soak)::
+
+    ZNICZ_FAULTS="engine.decode_step:times=1,frontdoor.slow_tick:delay=0.2"
+
+Each spec is ``point[:field]...`` with fields ``times=<int>`` (default
+unlimited), ``delay=<seconds>`` and ``flag`` (behavioral: fire just
+returns True); a point with none of them raises :class:`FaultInjected`
+when fired.  The hot-path cost of an UNARMED registry is one
+truthiness check on an empty dict.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+__all__ = [
+    "FaultInjected",
+    "inject",
+    "clear",
+    "fire",
+    "armed",
+    "injected",
+]
+
+
+class FaultInjected(RuntimeError):
+    """The default exception an armed raise-point throws."""
+
+
+class _Fault:
+    __slots__ = ("name", "exc", "delay", "remaining")
+
+    def __init__(self, name: str, exc: Optional[BaseException],
+                 delay: float, times: Optional[int]):
+        self.name = name
+        self.exc = exc
+        self.delay = float(delay)
+        self.remaining = times  # None = until cleared
+
+
+# module-level registry: empty in production, so fire() is one dict
+# truthiness check on the hot path
+_ARMED: Dict[str, _Fault] = {}
+_LOCK = threading.Lock()
+
+
+def inject(
+    name: str,
+    *,
+    exc: Optional[BaseException] = None,
+    delay: float = 0.0,
+    times: Optional[int] = None,
+    flag: bool = False,
+) -> None:
+    """Arm ``name``.  ``exc`` raises at the point; ``delay`` sleeps
+    there; ``flag`` arms a BEHAVIORAL point (``fire`` just returns
+    True — e.g. ``pool.pressure`` reports the pool dry).  With none of
+    the three, firing raises :class:`FaultInjected`.  ``times`` bounds
+    how many fires before auto-disarm (None = until :func:`clear`)."""
+    if exc is None and delay == 0.0 and not flag:
+        exc = FaultInjected(f"injected fault at {name!r}")
+    with _LOCK:
+        _ARMED[name] = _Fault(name, exc, delay, times)
+
+
+def clear(name: Optional[str] = None) -> None:
+    """Disarm ``name`` (or every point when None).  Idempotent."""
+    with _LOCK:
+        if name is None:
+            _ARMED.clear()
+        else:
+            _ARMED.pop(name, None)
+
+
+def armed(name: str) -> bool:
+    if not _ARMED:
+        return False
+    with _LOCK:
+        return name in _ARMED
+
+
+def fire(name: str) -> bool:
+    """The injection point: no-op False when ``name`` is unarmed; when
+    armed, sleeps ``delay`` and/or raises ``exc``, decrementing the
+    remaining-fires budget, and returns True (behavioral points branch
+    on it).  Thread-safe; auto-disarms once ``times`` is spent."""
+    if not _ARMED:  # production fast path: one dict truthiness check
+        return False
+    with _LOCK:
+        fault = _ARMED.get(name)
+        if fault is None:
+            return False
+        if fault.remaining is not None:
+            fault.remaining -= 1
+            if fault.remaining <= 0:
+                del _ARMED[name]
+    if fault.delay:
+        time.sleep(fault.delay)
+    if fault.exc is not None:
+        raise fault.exc
+    return True
+
+
+@contextlib.contextmanager
+def injected(
+    name: str,
+    *,
+    exc: Optional[BaseException] = None,
+    delay: float = 0.0,
+    times: Optional[int] = None,
+    flag: bool = False,
+) -> Iterator[None]:
+    """Scoped :func:`inject` — the point is disarmed on exit even if
+    the body (or the fault itself) raised."""
+    inject(name, exc=exc, delay=delay, times=times, flag=flag)
+    try:
+        yield
+    finally:
+        clear(name)
+
+
+def _parse_env(spec: str) -> None:
+    """``ZNICZ_FAULTS="a.b:times=1,c.d:delay=0.5"`` — malformed specs
+    raise at import so a typo'd chaos config can't silently arm
+    nothing."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        kwargs: Dict = {}
+        for field in fields[1:]:
+            key, _, value = field.partition("=")
+            if key == "times":
+                kwargs["times"] = int(value)
+            elif key == "delay":
+                kwargs["delay"] = float(value)
+            elif key == "flag" and not value:
+                kwargs["flag"] = True
+            else:
+                raise ValueError(
+                    f"ZNICZ_FAULTS: unknown field {key!r} in {part!r} "
+                    "(want times=<int>, delay=<seconds>, or flag)"
+                )
+        inject(fields[0], **kwargs)
+
+
+_ENV = os.environ.get("ZNICZ_FAULTS", "")
+if _ENV:
+    _parse_env(_ENV)
