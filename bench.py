@@ -54,11 +54,18 @@ def emit(rec) -> None:
 def _metrics_snapshot() -> dict:
     """The process-wide telemetry registry, attached to error records
     and the final summary so every round carries the serve/train
-    counters and latency histograms behind it."""
+    counters and latency histograms behind it.  A ``"slo"`` entry
+    (``{"type": "slo", ...}`` — self-describing next to the metric
+    families) carries the lifetime SLO judgment over the same registry:
+    per-target percentiles, burn rates and the breach flag that
+    ``tools/znicz-slo`` gates on."""
     try:
         from znicz_tpu.observability import get_registry
+        from znicz_tpu.observability import slo as slo_mod
 
-        return get_registry().snapshot()
+        snap = get_registry().snapshot()
+        snap["slo"] = slo_mod.lifetime_snapshot()
+        return snap
     except Exception as e:
         # the record must still print even if telemetry import breaks
         print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
@@ -1392,6 +1399,7 @@ def _sec_lm_serve_frontdoor(ctx):
                         out["n_new"] += 1
                     elif rec.get("done"):
                         out["finish_reason"] = rec.get("finish_reason")
+                        out["timings"] = rec.get("timings")
                 out["latency_s"] = time.time() - t_req
                 return out
             finally:
@@ -1427,13 +1435,29 @@ def _sec_lm_serve_frontdoor(ctx):
             if r.get("status") == 200
             and r.get("finish_reason") in ("eos", "budget")
         ]
+        def pctl(sorted_vals, q):
+            if not sorted_vals:
+                return 0.0
+            i = min(
+                len(sorted_vals) - 1,
+                int(round(q * (len(sorted_vals) - 1))),
+            )
+            return sorted_vals[i]
+
         ttfts = sorted(
             r["ttft_s"] for r in ok if r.get("ttft_s") is not None
         )
-        ttft_p99 = (
-            ttfts[min(len(ttfts) - 1, int(round(0.99 * (len(ttfts) - 1))))]
-            if ttfts else 0.0
+        ttft_p99 = pctl(ttfts, 0.99)
+        ttft_p50 = pctl(ttfts, 0.5)
+        # queue age from the done records' timings breakdown (ISSUE 7):
+        # how long requests WAITED (front-door pending + engine queue)
+        # before any tower work — the admission-ladder health number
+        queue_ages = sorted(
+            r["timings"]["queue_s"] for r in ok
+            if isinstance(r.get("timings"), dict)
+            and r["timings"].get("queue_s") is not None
         )
+        queue_age_p99 = pctl(queue_ages, 0.99)
         toks = sum(r.get("n_new", 0) for r in results)
         st = door.stats()
     finally:
@@ -1463,6 +1487,10 @@ def _sec_lm_serve_frontdoor(ctx):
             ),
             "lm_serve_frontdoor_tokens_per_sec": round(toks / wall, 1),
             "lm_serve_frontdoor_ttft_p99_ms": round(1000 * ttft_p99, 1),
+            "lm_serve_frontdoor_ttft_p50_ms": round(1000 * ttft_p50, 1),
+            "lm_serve_frontdoor_queue_age_p99_ms": round(
+                1000 * queue_age_p99, 1
+            ),
             "lm_serve_frontdoor_completed": len(ok),
             "lm_serve_frontdoor_rejected": sum(st["rejected"].values()),
             "lm_serve_frontdoor_deadline_exceeded": st[

@@ -1,0 +1,495 @@
+"""Fleet metrics aggregation: push/merge semantics, TTL expiry, the
+HTTP push + scrape surface, and the MetricsPusher slave side.
+
+The ISSUE 7 acceptance path: >= 2 registries pushing concurrently merge
+into ONE parse-clean Prometheus exposition with correct counter sums
+and bucket-wise histogram merges; stale instances TTL out.  Pure
+host-side — no jax, no compiled programs."""
+
+import json
+import http.client
+import threading
+
+import pytest
+
+from znicz_tpu.observability import parse_prometheus_text
+from znicz_tpu.observability.aggregate import (
+    MetricsAggregator,
+    MetricsPusher,
+    build_aggregator_server,
+)
+from znicz_tpu.observability.registry import MetricsRegistry
+from znicz_tpu.utils import faults
+
+
+def _registry(submitted, ttfts, pending=0.0, reasons=()):
+    r = MetricsRegistry()
+    r.counter("znicz_serve_requests_submitted_total", "req").inc(submitted)
+    h = r.histogram("znicz_serve_ttft_seconds", "ttft")
+    for t in ttfts:
+        h.observe(t)
+    r.gauge("znicz_serve_frontdoor_pending", "pend").set(pending)
+    ret = r.counter(
+        "znicz_serve_requests_retired_total", "ret", ("reason",)
+    )
+    for reason in reasons:
+        ret.labels(reason=reason).inc()
+    return r
+
+
+class TestMerge:
+    def test_counters_and_gauges_sum_across_instances(self):
+        agg = MetricsAggregator()
+        agg.push("a", _registry(3, [], pending=2).snapshot(), now=0.0)
+        agg.push("b", _registry(5, [], pending=7).snapshot(), now=0.0)
+        snap = agg.merged_snapshot(now=0.1)
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 8.0
+        )
+        assert (
+            snap["znicz_serve_frontdoor_pending"]["series"][0]["value"]
+            == 9.0
+        )
+        assert (
+            snap["znicz_aggregator_instances"]["series"][0]["value"]
+            == 2.0
+        )
+
+    def test_labeled_series_merge_per_labelset(self):
+        agg = MetricsAggregator()
+        agg.push(
+            "a",
+            _registry(1, [], reasons=("eos", "eos", "budget")).snapshot(),
+            now=0.0,
+        )
+        agg.push(
+            "b", _registry(1, [], reasons=("eos",)).snapshot(), now=0.0
+        )
+        snap = agg.merged_snapshot(now=0.0)
+        by_reason = {
+            s["labels"]["reason"]: s["value"]
+            for s in snap["znicz_serve_requests_retired_total"]["series"]
+        }
+        assert by_reason == {"eos": 3.0, "budget": 1.0}
+
+    def test_histograms_merge_bucket_wise(self):
+        a, b = [0.01, 0.02, 0.3], [0.02, 4.0]
+        agg = MetricsAggregator()
+        agg.push("a", _registry(0, a).snapshot(), now=0.0)
+        agg.push("b", _registry(0, b).snapshot(), now=0.0)
+        ser = agg.merged_snapshot(now=0.0)["znicz_serve_ttft_seconds"][
+            "series"
+        ][0]
+        assert ser["count"] == 5.0
+        assert ser["sum"] == pytest.approx(sum(a) + sum(b))
+        # cumulative per-edge sums: everything <= 0.025 is 3 samples
+        assert ser["buckets"]["0.025"] == 3.0
+        assert ser["buckets"]["+Inf"] == 5.0
+        assert ser["p50"] is not None
+
+    def test_bench_style_slo_side_entry_is_skipped_not_rejected(self):
+        # bench._metrics_snapshot() rides a self-describing
+        # {"type": "slo", ...} entry next to the metric families; a
+        # round-tripped push must keep every family and skip the side
+        # entry, not 400 the whole snapshot
+        snap = _registry(4, [0.01]).snapshot()
+        snap["slo"] = {"type": "slo", "targets": [], "breach": False}
+        agg = MetricsAggregator()
+        agg.push("bench", snap, now=0.0)
+        merged = agg.merged_snapshot(now=0.0)
+        assert (
+            merged["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 4.0
+        )
+        assert "slo" not in merged
+
+    def test_federated_push_drops_upstream_self_series(self):
+        # a tier-1 aggregator's merged /metrics federated into a tier-2
+        # aggregator: the upstream znicz_aggregator_* self-series are
+        # dropped at canon time — only the LOCAL aggregator speaks
+        # those names (never summed-then-overwritten, never a conflict)
+        tier1 = MetricsAggregator()
+        tier1.push("a", _registry(3, [0.01]).snapshot(), now=0.0)
+        tier1.push("b", _registry(4, []).snapshot(), now=0.0)
+        tier1.push("a", _registry(3, [0.01]).snapshot(), now=0.0)
+        tier2 = MetricsAggregator()
+        tier2.push("tier1", text=tier1.prometheus_text(now=0.0), now=0.0)
+        tier2.push("local", _registry(2, []).snapshot(), now=0.0)
+        snap = tier2.merged_snapshot(now=0.0)
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 9.0
+        )
+        # tier1 reported instances=2 pushes=3; tier2's own view wins
+        assert (
+            snap["znicz_aggregator_instances"]["series"][0]["value"] == 2.0
+        )
+        assert (
+            snap["znicz_aggregator_pushes_total"]["series"][0]["value"]
+            == 2.0
+        )
+        assert (
+            snap["znicz_aggregator_merge_conflicts"]["series"][0]["value"]
+            == 0.0
+        )
+
+    def test_json_and_prom_pushes_merge_identically(self):
+        r1, r2 = _registry(2, [0.01]), _registry(3, [0.5])
+        agg = MetricsAggregator()
+        agg.push("json", r1.snapshot(), now=0.0)
+        agg.push("prom", text=r2.prometheus_text(), now=0.0)
+        snap = agg.merged_snapshot(now=0.0)
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 5.0
+        )
+        assert snap["znicz_serve_ttft_seconds"]["series"][0]["count"] == 2.0
+
+    def test_merged_exposition_parse_clean_round_trip(self):
+        agg = MetricsAggregator()
+        agg.push("a", _registry(3, [0.01, 0.4]).snapshot(), now=0.0)
+        agg.push("b", _registry(4, [0.02]).snapshot(), now=0.0)
+        text = agg.prometheus_text(now=0.0)
+        parsed = parse_prometheus_text(text)  # histogram invariants too
+        samples = {
+            (n, tuple(sorted(lbl.items()))): v
+            for n, lbl, v in parsed["samples"]
+        }
+        assert (
+            samples[("znicz_serve_requests_submitted_total", ())] == 7.0
+        )
+        assert samples[("znicz_serve_ttft_seconds_count", ())] == 3.0
+        assert parsed["types"]["znicz_serve_ttft_seconds"] == "histogram"
+
+    def test_last_push_wins_per_instance(self):
+        agg = MetricsAggregator()
+        agg.push("a", _registry(3, []).snapshot(), now=0.0)
+        agg.push("a", _registry(10, []).snapshot(), now=1.0)
+        snap = agg.merged_snapshot(now=1.0)
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 10.0
+        )
+        assert agg.instances(now=1.0)[0]["pushes"] == 2
+
+    def test_kind_conflict_skips_not_corrupts(self):
+        r = MetricsRegistry()
+        r.counter("znicz_thing_total", "as counter").inc(5)
+        r2 = MetricsRegistry()
+        r2.gauge("znicz_thing_total", "as gauge").set(100)
+        agg = MetricsAggregator()
+        agg.push("a", r.snapshot(), now=0.0)
+        agg.push("b", r2.snapshot(), now=0.0)
+        snap = agg.merged_snapshot(now=0.0)
+        assert snap["znicz_thing_total"]["series"][0]["value"] == 5.0
+        assert (
+            snap["znicz_aggregator_merge_conflicts"]["series"][0][
+                "value"
+            ]
+            == 1.0
+        )
+        # a GAUGE of the current view: re-reading the same persistent
+        # conflict must not inflate it (reads never mutate)
+        for _ in range(3):
+            again = agg.merged_snapshot(now=0.0)
+            assert (
+                again["znicz_aggregator_merge_conflicts"]["series"][0][
+                    "value"
+                ]
+                == 1.0
+            )
+        assert (
+            again["znicz_aggregator_merge_conflicts"]["type"] == "gauge"
+        )
+        parse_prometheus_text(agg.prometheus_text(now=0.0))
+
+    def test_malformed_push_raises_and_applies_nothing(self):
+        agg = MetricsAggregator()
+        with pytest.raises(ValueError):
+            agg.push("a", {"bad": "not a family"})
+        with pytest.raises(ValueError):
+            agg.push("a", text="not { prometheus")
+        with pytest.raises(ValueError):
+            agg.push("a")  # neither snapshot nor text
+        with pytest.raises(ValueError):
+            agg.push(
+                "a", _registry(1, []).snapshot(), text="x"
+            )  # both
+        assert agg.instances() == []
+
+
+class TestTTL:
+    def test_stale_instance_expires_out_of_the_merge(self):
+        agg = MetricsAggregator(default_ttl_s=5.0)
+        agg.push("old", _registry(3, []).snapshot(), now=0.0)
+        agg.push("live", _registry(4, []).snapshot(), now=8.0)
+        snap = agg.merged_snapshot(now=9.0)
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 4.0
+        )
+        assert [i["instance"] for i in agg.instances(now=9.0)] == ["live"]
+
+    def test_per_push_ttl_overrides_default(self):
+        agg = MetricsAggregator(default_ttl_s=1000.0)
+        agg.push("short", _registry(1, []).snapshot(), ttl_s=2.0, now=0.0)
+        agg.push("long", _registry(1, []).snapshot(), now=0.0)
+        assert [i["instance"] for i in agg.instances(now=5.0)] == ["long"]
+
+    def test_repush_revives_before_expiry_boundary(self):
+        agg = MetricsAggregator(default_ttl_s=5.0)
+        agg.push("a", _registry(1, []).snapshot(), now=0.0)
+        agg.push("a", _registry(2, []).snapshot(), now=4.0)
+        assert len(agg.instances(now=8.0)) == 1  # 8-4 < 5: still live
+
+    def test_forget_drops_immediately(self):
+        agg = MetricsAggregator()
+        agg.push("a", _registry(1, []).snapshot(), now=0.0)
+        assert agg.forget("a") is True
+        assert agg.forget("a") is False
+        assert agg.instances(now=0.0) == []
+
+
+@pytest.fixture()
+def agg_server():
+    server = build_aggregator_server(port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestHTTPSurface:
+    def test_concurrent_pushers_merge_end_to_end(self, agg_server):
+        # the acceptance path: two registries push CONCURRENTLY over
+        # real HTTP; the merged scrape is parse-clean with exact sums
+        port = agg_server.server_address[1]
+        regs = {
+            "replica-0": _registry(3, [0.01, 0.02]),
+            "replica-1": _registry(9, [0.5]),
+        }
+        pushers = {
+            name: MetricsPusher(
+                f"http://127.0.0.1:{port}", instance=name,
+                registry=reg, interval_s=60.0,
+            )
+            for name, reg in regs.items()
+        }
+        threads = [
+            threading.Thread(target=p.push_now)
+            for p in pushers.values()
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        status, body = _get(port, "/metrics")
+        assert status == 200
+        parsed = parse_prometheus_text(body.decode())
+        flat = {
+            (n, tuple(sorted(lbl.items()))): v
+            for n, lbl, v in parsed["samples"]
+        }
+        assert (
+            flat[("znicz_serve_requests_submitted_total", ())] == 12.0
+        )
+        assert flat[("znicz_serve_ttft_seconds_count", ())] == 3.0
+        status, body = _get(port, "/instances")
+        roster = json.loads(body)
+        assert roster["live"] == 2
+        assert {i["instance"] for i in roster["instances"]} == set(regs)
+        status, body = _get(port, "/metrics.json")
+        assert status == 200
+        snap = json.loads(body)
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 12.0
+        )
+
+    def test_text_push_with_instance_query(self, agg_server):
+        port = agg_server.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request(
+                "POST", "/push?instance=prom-replica",
+                body=_registry(6, []).prometheus_text(),
+                headers={"Content-Type": "text/plain"},
+            )
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+        _, body = _get(port, "/metrics.json")
+        snap = json.loads(body)
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 6.0
+        )
+
+    def test_bad_pushes_answer_400(self, agg_server):
+        port = agg_server.server_address[1]
+        for body, headers in (
+            (b"{}", {"Content-Type": "application/json"}),  # no instance
+            (b"garbage {", {"Content-Type": "text/plain"}),  # no instance
+            (
+                json.dumps(
+                    {"instance": "x", "snapshot": {"bad": 1}}
+                ).encode(),
+                {"Content-Type": "application/json"},
+            ),
+            # non-object JSON: a 400, not an AttributeError-dropped
+            # connection
+            (b"[1, 2, 3]", {"Content-Type": "application/json"}),
+            (b'"str"', {"Content-Type": "application/json"}),
+            # non-object series entries: same contract
+            (
+                json.dumps(
+                    {
+                        "instance": "x",
+                        "snapshot": {
+                            "f": {"type": "gauge", "series": [42]}
+                        },
+                    }
+                ).encode(),
+                {"Content-Type": "application/json"},
+            ),
+        ):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=10
+            )
+            try:
+                conn.request("POST", "/push", body=body, headers=headers)
+                assert conn.getresponse().status == 400
+            finally:
+                conn.close()
+        _, body = _get(port, "/instances")
+        assert json.loads(body)["live"] == 0
+
+    def test_unknown_paths_404_and_healthz_ok(self, agg_server):
+        port = agg_server.server_address[1]
+        assert _get(port, "/healthz")[0] == 200
+        assert _get(port, "/nope")[0] == 404
+
+
+class TestPusher:
+    def test_push_failure_never_raises(self):
+        # nothing listening on a fresh ephemeral port
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        p = MetricsPusher(
+            f"http://127.0.0.1:{port}", instance="x",
+            registry=_registry(1, []), timeout_s=0.5,
+        )
+        assert p.push_now() is False
+        assert p.pushes_failed == 1
+
+    def test_fault_point_is_injectable(self, agg_server):
+        port = agg_server.server_address[1]
+        p = MetricsPusher(
+            f"http://127.0.0.1:{port}", instance="x",
+            registry=_registry(1, []),
+        )
+        with faults.injected("pusher.push", times=1):
+            assert p.push_now() is False  # injected failure, swallowed
+        assert p.push_now() is True  # disarmed: lands
+        assert p.pushes_ok == 1 and p.pushes_failed == 1
+
+    def test_background_loop_and_final_flush(self, agg_server):
+        port = agg_server.server_address[1]
+        reg = _registry(2, [])
+        p = MetricsPusher(
+            f"http://127.0.0.1:{port}", instance="bg", registry=reg,
+            interval_s=0.05,
+        )
+        p.start()
+        deadline = 50
+        while p.pushes_ok == 0 and deadline:
+            import time as _t
+
+            _t.sleep(0.05)
+            deadline -= 1
+        reg.counter("znicz_serve_requests_submitted_total", "req").inc(100)
+        p.stop()  # final flush carries the bump
+        snap = agg_server.aggregator.merged_snapshot()
+        assert (
+            snap["znicz_serve_requests_submitted_total"]["series"][0][
+                "value"
+            ]
+            == 102.0
+        )
+
+    def test_rejects_non_http_urls(self):
+        with pytest.raises(ValueError):
+            MetricsPusher("ftp://somewhere/push")
+        with pytest.raises(ValueError):
+            MetricsPusher("http://", instance="x")
+
+
+class TestStatusWriterWiring:
+    def test_status_writer_pushes_training_registry(
+        self, tmp_path, agg_server
+    ):
+        # training side of the fleet view: StatusWriter's epoch hook
+        # lands the process registry in the aggregator synchronously
+        from znicz_tpu.services.web_status import StatusWriter
+
+        port = agg_server.server_address[1]
+        w = StatusWriter(
+            str(tmp_path),
+            aggregator_url=f"http://127.0.0.1:{port}",
+            instance="trainer",
+            push_interval_s=60.0,
+        )
+
+        class _Dec:
+            epoch = 1
+            max_epochs = 1
+            best_value = 0.0
+            best_epoch = 0
+            history = []
+
+        class _WF:
+            name = "wf"
+            decision = _Dec()
+            timer = None
+
+        w.on_epoch(
+            _WF(),
+            {"improved": False, "stop": True, "summary": {}},
+        )
+        w.close()
+        roster = agg_server.aggregator.instances()
+        assert any(i["instance"] == "trainer" for i in roster)

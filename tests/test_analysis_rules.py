@@ -1368,6 +1368,102 @@ class TestUnboundedBlocking:
         assert fs == []
 
 
+# -- ZNC011: dynamic metric names -----------------------------------------
+
+
+class TestDynamicMetricNames:
+    def test_fstring_name_fires(self):
+        fs = run(
+            """
+            from znicz_tpu import observability
+
+            def make(kind):
+                return observability.counter(f"znicz_{kind}_total")
+            """,
+            "ZNC011",
+        )
+        assert ids(fs) == ["ZNC011"]
+        assert "label" in fs[0].message
+
+    def test_concat_percent_and_format_fire(self):
+        fs = run(
+            """
+            def make(reg, name, phase):
+                a = reg.gauge("znicz_" + name)
+                b = reg.histogram("znicz_%s_seconds" % phase)
+                c = reg.counter("znicz_{}_total".format(name))
+                return a, b, c
+            """,
+            "ZNC011",
+        )
+        assert ids(fs) == ["ZNC011"] * 3
+
+    def test_bare_and_keyword_name_forms_fire(self):
+        fs = run(
+            """
+            from znicz_tpu.observability import counter, gauge
+
+            def make(kind):
+                counter(f"znicz_{kind}_total")
+                gauge(name="znicz_" + kind)
+            """,
+            "ZNC011",
+        )
+        assert ids(fs) == ["ZNC011"] * 2
+
+    def test_static_names_and_variables_stay_quiet(self):
+        # literal names, a pass-through variable (PhaseTimer's metric
+        # param), labels carrying the varying value, and non-factory
+        # homonyms must all stay quiet
+        fs = run(
+            """
+            from collections import Counter
+
+            def make(reg, metric, kind):
+                a = reg.counter("znicz_serve_requests_total", "h",
+                                ("kind",))
+                a.labels(kind=kind).inc()
+                b = reg.histogram(metric)  # variable: may be static
+                c = Counter(f"not a {kind} metric")  # uppercase: not ours
+                d = "x".format()  # format off a factory-free call
+                return a, b, c, d
+            """,
+            "ZNC011",
+        )
+        assert fs == []
+
+    def test_nested_concat_with_literal_fires(self):
+        fs = run(
+            """
+            def make(reg, a, b):
+                return reg.counter(a + b + "_total")
+            """,
+            "ZNC011",
+        )
+        assert ids(fs) == ["ZNC011"]
+
+    def test_plain_fstring_without_interpolation_is_quiet(self):
+        fs = run(
+            """
+            def make(reg):
+                return reg.counter(f"znicz_static_total")
+            """,
+            "ZNC011",
+        )
+        assert fs == []
+
+    def test_pragma_exempts(self):
+        fs = run(
+            """
+            def make(reg, kind):
+                # one-off migration shim, bounded set of kinds
+                return reg.counter(f"znicz_{kind}_total")  # znicz-check: disable=ZNC011
+            """,
+            "ZNC011",
+        )
+        assert fs == []
+
+
 # -- pragmas -------------------------------------------------------------
 
 

@@ -131,6 +131,25 @@ def _labeled_sum(name):
     return sum(c.value for c in m.children().values())
 
 
+def _hist_count(name):
+    m = obs.get_registry().metrics().get(name)
+    if m is None:
+        return 0
+    return sum(c.count for c in m.children().values())
+
+
+def _retired_errors():
+    m = obs.get_registry().metrics().get(
+        "znicz_serve_requests_retired_total"
+    )
+    if m is None:
+        return 0.0
+    return sum(
+        c.value for key, c in m.children().items()
+        if key and key[0] == "error"
+    )
+
+
 def _paged_compiles_total():
     m = obs.get_registry().metrics().get("znicz_serve_compiles_total")
     if m is None:
@@ -332,6 +351,16 @@ class TestWatchdog:
         pa = _long_prompt(params, budget=30)
         pb, pc = _prompts(3)[1:]
         factory = _engine_factory(params, batch_size=1, admit_every=2)
+        # pre-compile this factory's whole program ladder (prefill +
+        # every x2 window rung pa can reach — the paged_chunk key is
+        # per (admit_every, batch_size), so the module _warm doesn't
+        # cover it): the zero-new-compiles pin below must measure only
+        # restart-caused compiles, not a rung the stream itself happens
+        # to touch for the first time after the snapshot (a race on how
+        # far A has decoded when the crash lands)
+        warm = factory()
+        warm.submit(pa, 30)
+        warm.run()
         # slow ticks: A's 30-token budget spans >= 15 ticks x 50 ms, so
         # the crash deterministically lands while A is still decoding
         faults.inject("frontdoor.slow_tick", delay=0.05)
@@ -346,6 +375,10 @@ class TestWatchdog:
             )
             engine_before = door.engine
             compiles_before = _paged_compiles_total()
+            lat_before = _hist_count(
+                "znicz_serve_frontdoor_latency_seconds"
+            )
+            err_before = _retired_errors()
             faults.inject(
                 "engine.decode_step", exc=RuntimeError("boom"), times=1
             )
@@ -353,6 +386,12 @@ class TestWatchdog:
             faults.clear("frontdoor.slow_tick")
             assert ca.finish_reason == "error"
             assert "boom" in ca.error
+            # the dead engine's REAL per-request accounting rides the
+            # error completion: A was mid-decode when the engine
+            # crashed, so its breakdown must say so — not the
+            # never-reached-the-engine fallback's 100% queue wait
+            assert ca.timings["decode_s"] > 0
+            assert ca.timings["prefill_s"] > 0
             for h, p in ((hb, pb), (hc, pc)):
                 comp = h.result(timeout=60.0)
                 assert comp.finish_reason in ("eos", "budget")
@@ -362,6 +401,17 @@ class TestWatchdog:
             st = door.stats()
             assert st["watchdog_restarts"] == 1
             assert door.engine is not engine_before
+            # crash-failed A is NOT a latency measurement (its 'time to
+            # crash' would dilute the SLO histogram mid-incident); only
+            # B and C land in the client-clock latency series.  A IS an
+            # error: retired{reason=error} must tick so /slo error_rate
+            # sees the incident
+            assert (
+                _hist_count("znicz_serve_frontdoor_latency_seconds")
+                - lat_before
+                == 2
+            )
+            assert _retired_errors() - err_before == 1.0
             # watchdog restarts ride the warm jit caches: zero new
             # compiled programs, pinned via znicz_serve_compiles_total
             assert _paged_compiles_total() == compiles_before
@@ -474,6 +524,204 @@ class TestCompileBudget:
             ledger = door.engine.compile_stats()["programs"]
         assert _paged_compiles_total() == before
         assert {k[0] for k in ledger} <= {"prefill", "paged_chunk", "cow"}
+
+
+_TIMING_KEYS = {
+    "queue_s", "prefill_s", "decode_s", "preemptions", "cached_tokens"
+}
+
+
+class TestRequestTimings:
+    def test_every_completion_carries_the_breakdown(self, params):
+        prompts, budgets = _prompts(3), [6, 4, 8]
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            handles = [
+                door.submit(p, b) for p, b in zip(prompts, budgets)
+            ]
+            for h in handles:
+                comp = h.result(timeout=30.0)
+                assert comp.timings is not None
+                assert set(comp.timings) == _TIMING_KEYS
+                assert comp.timings["queue_s"] >= 0.0
+                # an admitted request did real prefill + decode work
+                assert comp.timings["prefill_s"] > 0.0
+                assert comp.timings["decode_s"] > 0.0
+            recent = door.recent_requests()
+        assert len(recent) == 3
+        assert recent[0]["timings"] is not None  # newest first
+        assert {r["trace_id"] for r in recent} == {h.id for h in handles}
+
+    def test_prefill_dominated_vs_queue_dominated_golden(self, params):
+        # the acceptance golden: "why was this request slow" must have
+        # two distinguishable answers.  (1) one long prompt, budget 1:
+        # all prefill, no queue wait.  (2) a request parked behind a
+        # busy single-slot engine: all queue wait, one chunk of prefill.
+        long_p = np.arange(48, dtype=np.int32) % 16 + 1
+        eng = _engine_factory(params, batch_size=1)()
+        rid = eng.submit(long_p, 1)
+        eng.run()
+        t = eng.completions[rid].timings
+        assert t["prefill_s"] > t["queue_s"]
+        assert t["decode_s"] == 0.0  # retired at admission
+
+        eng = _engine_factory(params, batch_size=1)()
+        first = eng.submit(_long_prompt(params), 40)
+        second = eng.submit(_prompts(1)[0], 2)
+        eng.run()
+        t2 = eng.completions[second].timings
+        # the second request sat queued through the first's whole
+        # 40-token decode: waiting dwarfs its own prefill
+        assert t2["queue_s"] > t2["prefill_s"]
+        assert t2["queue_s"] > eng.completions[first].timings["queue_s"]
+
+    def test_preemption_and_cache_counts_land_in_timings(self, params):
+        # pool pressure: 2 slots, a pool too small for both -> the
+        # younger is preempted and recomputed; its breakdown says so
+        factory = _engine_factory(
+            params, batch_size=2, n_blocks=2 * (40 // BS) - 1,
+            prefix_cache=False,
+        )
+        eng = factory()
+        a = eng.submit(_long_prompt(params), 28)
+        b = eng.submit(_long_prompt(params, seed=22), 28)
+        eng.run()
+        timings = [eng.completions[r].timings for r in (a, b)]
+        assert sum(t["preemptions"] for t in timings) >= 1
+        # prefix reuse: same prompt twice -> the second's cached_tokens
+        eng2 = _engine_factory(params)()
+        p = np.arange(2 * BS, dtype=np.int32) % 16 + 1
+        r1 = eng2.submit(p, 3)
+        eng2.run()
+        r2 = eng2.submit(p, 3)
+        eng2.run()
+        assert eng2.completions[r1].timings["cached_tokens"] == 0
+        assert eng2.completions[r2].timings["cached_tokens"] > 0
+
+    def test_queued_termination_is_pure_queue_time(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params), engine_queue_limit=0
+        ) as door:
+            h = door.submit(_prompts(1)[0], 4)  # parked forever
+            time.sleep(0.05)
+            h.cancel()
+            comp = h.result(timeout=30.0)
+        assert comp.finish_reason == "cancelled"
+        assert comp.timings["queue_s"] >= 0.05
+        assert comp.timings["prefill_s"] == 0.0
+        assert comp.timings["decode_s"] == 0.0
+
+    def test_trace_id_reaches_engine_spans_and_instants(self, params):
+        tracer = obs.get_tracer()
+        tracer.start()
+        try:
+            with ServingFrontDoor(_engine_factory(params)) as door:
+                h = door.submit(_prompts(1)[0], 4)
+                h.result(timeout=30.0)
+                tid = h.id
+        finally:
+            events = tracer.stop()
+        admits = [
+            e for e in events
+            if e["name"] == "serve/admit"
+            and e.get("args", {}).get("trace") == tid
+        ]
+        assert len(admits) == 1
+        lifecycle = {
+            e["name"] for e in events
+            if e.get("args", {}).get("trace") == tid
+        }
+        assert "serve/queued" in lifecycle
+        assert "serve/retired" in lifecycle
+
+    def test_debug_ring_is_bounded(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params), debug_requests=2
+        ) as door:
+            handles = [door.submit(_prompts(1)[0], 2) for _ in range(4)]
+            for h in handles:
+                h.result(timeout=30.0)
+            recent = door.recent_requests()
+        assert len(recent) == 2
+        assert recent[0]["trace_id"] == handles[-1].id  # newest first
+
+
+class TestSLOEndpointBehavior:
+    def test_slo_breach_under_injected_latency_then_recovery(
+        self, params
+    ):
+        # the acceptance path: fault-injected slow ticks push TTFT over
+        # a tight threshold -> burn rate breaches in EVERY window; after
+        # the fault clears, fast requests wash the short window clean ->
+        # breach clears (multi-window AND), p99s visibly recover
+        from znicz_tpu.observability.slo import SLOTarget
+
+        reg = obs.get_registry()
+        with ServingFrontDoor(
+            _engine_factory(params),
+            slo_targets=(
+                SLOTarget(
+                    "ttft", "znicz_serve_frontdoor_ttft_seconds",
+                    0.15, 0.9,
+                ),
+            ),
+            slo_windows_s=(0.6, 120.0),
+            slo_sample_gap_s=0.0,
+        ) as door:
+            mon = door._slo
+            mon.sample()  # pristine baseline before any traffic
+            with faults.injected("frontdoor.slow_tick", delay=0.3):
+                for _ in range(3):
+                    door.submit(_prompts(1)[0], 2).result(timeout=30.0)
+            snap = door.slo_snapshot()
+            assert snap["targets"]["ttft"]["breached"] is True
+            assert snap["breached"] is True
+            slow_p99 = snap["targets"]["ttft"]["windows"]["120"]["p99_s"]
+            assert slow_p99 is not None and slow_p99 > 0.15
+            # recovery: fault cleared, let the short window age out the
+            # slow samples, then run fast traffic
+            mon.sample()
+            time.sleep(0.7)
+            for _ in range(6):
+                door.submit(_prompts(1)[0], 2).result(timeout=30.0)
+            snap = door.slo_snapshot()
+            short = snap["targets"]["ttft"]["windows"]["0.6"]
+            assert short["n"] >= 6
+            assert short["burn_rate"] < 1.0
+            assert snap["targets"]["ttft"]["breached"] is False
+
+    def test_observability_paths_add_zero_compiled_programs(self, params):
+        # the host-side observability machinery (slo snapshot, debug
+        # ring, aggregator push/merge of the live registry) must not
+        # touch the compile ledger or the jit caches
+        from znicz_tpu.observability.aggregate import MetricsAggregator
+
+        prompts, budgets = _prompts(3), [6, 4, 8]
+        with ServingFrontDoor(_engine_factory(params)) as door:
+            for p, b in zip(prompts, budgets):
+                door.submit(p, b).result(timeout=30.0)
+            eng = door.engine
+            ledger_before = dict(eng.compile_stats()["programs"])
+            jit_before = {
+                k: v for k, v in eng.compile_stats().items()
+                if k.endswith("_jit_entries")
+            }
+            compiles_before = _paged_compiles_total()
+            for _ in range(3):
+                door.slo_snapshot()
+                door.recent_requests()
+            agg = MetricsAggregator()
+            agg.push("self", obs.get_registry().snapshot())
+            agg.push("twin", text=obs.get_registry().prometheus_text())
+            agg.merged_snapshot()
+            agg.prometheus_text()
+            door.submit(prompts[0], budgets[0]).result(timeout=30.0)
+            stats = eng.compile_stats()
+        assert stats["programs"] == ledger_before
+        assert {
+            k: v for k, v in stats.items()
+            if k.endswith("_jit_entries")
+        } == jit_before
+        assert _paged_compiles_total() == compiles_before
 
 
 class TestFaultsHarness:

@@ -243,6 +243,98 @@ class TestTracer:
         assert len(events) == 2 and tr.dropped == 3
         assert len(path.read_text().splitlines()) == 5
 
+    def test_file_rotation_caps_disk_and_keeps_newest(self, tmp_path):
+        # ISSUE 7 satellite: the streamed file is size-capped — it
+        # rotates to <path>.1 instead of growing without bound on a
+        # long-running server; the newest window stays in <path>
+        path = tmp_path / "trace.jsonl"
+        tr = Tracer()
+        tr.start(path=str(path), max_file_bytes=400)
+        for i in range(40):
+            with tr.span(f"span-{i:03d}"):
+                pass
+        tr.stop()
+        assert tr.rotations >= 1
+        rotated = tmp_path / "trace.jsonl.1"
+        assert rotated.exists()
+        assert rotated.stat().st_size <= 400 + 200  # one line of slack
+        # every line in both generations is valid JSONL, no torn writes
+        names = []
+        for p in (rotated, path):
+            for line in p.read_text().splitlines():
+                names.append(json.loads(line)["name"])
+        # the newest span is in the live file; rotation loses only the
+        # OLDEST generation (at most one cap's worth)
+        assert json.loads(
+            path.read_text().splitlines()[-1]
+        )["name"] == "span-039"
+        assert names == sorted(names)  # contiguous suffix, in order
+
+    def test_doubly_failed_rotation_degrades_to_memory_buffer(
+        self, tmp_path, monkeypatch
+    ):
+        # rename fails AND the append-reopen fails (dir deleted, EROFS):
+        # the stream is lost, _file goes None — the NEXT span must land
+        # in the in-memory buffer, not raise AttributeError on the
+        # instrumented thread
+        path = tmp_path / "doomed.jsonl"
+        tr = Tracer()
+        tr.start(path=str(path), max_file_bytes=120)
+
+        def _boom(*a, **kw):
+            raise OSError("gone")
+
+        monkeypatch.setattr("znicz_tpu.observability.tracing.os.replace",
+                            _boom)
+        monkeypatch.setattr("builtins.open", _boom)
+        for i in range(20):
+            with tr.span(f"s{i}"):
+                pass
+        monkeypatch.undo()
+        events = tr.stop()
+        assert [e["name"] for e in events[-3:]] == ["s17", "s18", "s19"]
+
+    def test_rotation_disabled_streams_unbounded(self, tmp_path):
+        path = tmp_path / "unbounded.jsonl"
+        tr = Tracer()
+        tr.start(path=str(path), max_file_bytes=None)
+        for i in range(50):
+            with tr.span(f"s{i}"):
+                pass
+        tr.stop()
+        assert tr.rotations == 0
+        assert len(path.read_text().splitlines()) == 50
+
+    def test_shutdown_gracefully_flushes_the_tracer(self, tmp_path):
+        # run_server's SIGTERM path calls shutdown_gracefully, which
+        # must stop a recording tracer so the JSONL is flushed/closed
+        from znicz_tpu.observability import get_tracer
+        from znicz_tpu.services import serve as serve_mod
+
+        path = tmp_path / "drain.jsonl"
+        tracer = get_tracer()
+        tracer.start(path=str(path))
+        try:
+            with tracer.span("final-request"):
+                pass
+            server = serve_mod.build_server(
+                directory=str(tmp_path), port=0
+            )
+            threading.Thread(
+                target=server.serve_forever, daemon=True
+            ).start()
+            serve_mod.shutdown_gracefully(server)
+            server.server_close()
+            assert tracer.recording is False
+            lines = path.read_text().splitlines()
+            assert any(
+                json.loads(ln)["name"] == "final-request"
+                for ln in lines
+            )
+        finally:
+            if tracer.recording:
+                tracer.stop()
+
     def test_start_twice_raises_and_write_jsonl(self, tmp_path):
         tr = Tracer()
         tr.start()

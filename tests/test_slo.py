@@ -1,0 +1,352 @@
+"""SLO monitor: windowed percentiles, burn rates, breach/recovery, the
+lifetime evaluation behind tools/znicz-slo, and the /slo + front-door
+integration (fault-injected latency flips it to breach, then recovers).
+"""
+
+import json
+import math
+
+import pytest
+
+from znicz_tpu.observability.registry import (
+    MetricsRegistry,
+    fraction_le,
+    quantile_from_cumulative,
+)
+from znicz_tpu.observability import slo as slo_mod
+from znicz_tpu.observability.slo import SLOMonitor, SLOTarget
+
+
+def _reg():
+    r = MetricsRegistry()
+    r.histogram("znicz_serve_ttft_seconds", "ttft")
+    r.histogram("znicz_serve_request_latency_seconds", "lat")
+    r.counter("znicz_serve_requests_submitted_total", "req")
+    r.counter(
+        "znicz_serve_requests_retired_total", "ret", ("reason",)
+    )
+    r.counter("znicz_serve_rejected_total", "rej", ("reason",))
+    r.counter("znicz_serve_deadline_exceeded_total", "dl")
+    r.counter("znicz_serve_cancelled_total", "cx")
+    return r
+
+
+def _observe(r, metric, values, requests=None):
+    h = r.metrics()[metric]
+    for v in values:
+        h.observe(v)
+    n = len(values) if requests is None else requests
+    r.counter("znicz_serve_requests_submitted_total", "req").inc(n)
+
+
+TT = SLOTarget("ttft", "znicz_serve_ttft_seconds", 0.05, 0.9)
+
+
+class TestMath:
+    def test_fraction_le_interpolates_within_buckets(self):
+        cum = [(0.1, 0.0), (1.0, 10.0), (math.inf, 10.0)]
+        # all 10 samples are in (0.1, 1.0]; 0.55 is halfway through
+        assert fraction_le(cum, 0.55) == pytest.approx(0.5)
+        assert fraction_le(cum, 1.0) == pytest.approx(1.0)
+        assert fraction_le(cum, 0.1) == pytest.approx(0.0)
+
+    def test_fraction_le_empty_is_all_good(self):
+        assert fraction_le([], 1.0) == 1.0
+        assert fraction_le([(1.0, 0.0), (math.inf, 0.0)], 0.5) == 1.0
+
+    def test_fraction_le_inf_bucket_counts_as_bad(self):
+        cum = [(1.0, 5.0), (math.inf, 10.0)]
+        # 5 samples past the last finite edge: provably-below only
+        assert fraction_le(cum, 2.0) == pytest.approx(0.5)
+
+    def test_quantile_from_cumulative_matches_registry(self):
+        r = _reg()
+        h = r.metrics()["znicz_serve_ttft_seconds"]
+        for v in (0.001, 0.002, 0.003, 0.004):
+            h.observe(v)
+        child = h.children()[()]
+        assert quantile_from_cumulative(
+            child.cumulative(), 0.5
+        ) == pytest.approx(child.quantile(0.5))
+
+    def test_target_validation(self):
+        with pytest.raises(ValueError):
+            SLOTarget("x", "m", 1.0, objective=1.0)
+        with pytest.raises(ValueError):
+            SLOTarget("x", "m", 0.0)
+
+
+class TestMonitorWindows:
+    def test_windowed_deltas_see_only_the_window(self):
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), windows_s=(10.0, 100.0), registry=r,
+            min_sample_gap_s=0.0,
+        )
+        mon.sample(now=0.0)  # pristine baseline
+        _observe(r, "znicz_serve_ttft_seconds", [0.2] * 10)  # slow era
+        mon.sample(now=5.0)
+        _observe(r, "znicz_serve_ttft_seconds", [0.001] * 10)  # fast era
+        mon.sample(now=95.0)
+        snap = mon.snapshot(now=100.0)
+        w = snap["targets"]["ttft"]["windows"]
+        # short window: only the fast era
+        assert w["10"]["n"] == 10.0
+        assert w["10"]["bad_frac"] == 0.0
+        # long window: both eras
+        assert w["100"]["n"] == 20.0
+        assert w["100"]["bad_frac"] == pytest.approx(0.5)
+
+    def test_short_uptime_reports_true_span(self):
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), windows_s=(3600.0,), registry=r,
+            min_sample_gap_s=0.0,
+        )
+        mon.sample(now=0.0)
+        _observe(r, "znicz_serve_ttft_seconds", [0.001])
+        snap = mon.snapshot(now=30.0)
+        assert snap["targets"]["ttft"]["windows"]["3600"][
+            "span_s"
+        ] == pytest.approx(30.0)
+
+    def test_unsampled_monitor_does_not_fabricate_window_span(self):
+        # a directly-constructed monitor whose snapshot() runs before
+        # any sample() landed: lifetime counter totals must not be
+        # reported as if they spanned exactly one window (a 2-hour-old
+        # process would claim requests_per_s = lifetime/60); the span
+        # is the monitor's true (tiny) age
+        r = _reg()
+        r.counter("znicz_serve_requests_submitted_total", "req").inc(
+            36000
+        )
+        mon = SLOMonitor(targets=(TT,), windows_s=(60.0,), registry=r)
+        snap = mon.snapshot()
+        row = snap["rates"]["60"]
+        assert row["requests"] == 36000.0
+        assert row["span_s"] < 1.0  # true age, not a claimed 60s
+
+    def test_breach_needs_every_window_burning_and_recovers(self):
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), windows_s=(10.0, 100.0), registry=r,
+            min_sample_gap_s=0.0,
+        )
+        mon.sample(now=0.0)
+        _observe(r, "znicz_serve_ttft_seconds", [0.2] * 20)
+        snap = mon.snapshot(now=5.0)  # bad samples in BOTH windows
+        assert snap["targets"]["ttft"]["breached"] is True
+        assert snap["breached"] is True
+        mon.sample(now=5.0)
+        _observe(r, "znicz_serve_ttft_seconds", [0.001] * 20)
+        snap = mon.snapshot(now=50.0)
+        # short window clean -> breach clears even though the long
+        # window still remembers the incident (multi-window AND)
+        assert snap["targets"]["ttft"]["windows"]["10"]["burn_rate"] < 1.0
+        assert snap["targets"]["ttft"]["windows"]["100"][
+            "burn_rate"
+        ] >= 1.0
+        assert snap["targets"]["ttft"]["breached"] is False
+
+    def test_no_traffic_is_not_a_breach(self):
+        r = _reg()
+        mon = SLOMonitor(targets=(TT,), registry=r, min_sample_gap_s=0.0)
+        snap = mon.snapshot(now=0.0)
+        assert snap["breached"] is False
+        for ev in snap["targets"]["ttft"]["windows"].values():
+            assert ev["n"] == 0.0 and ev["burn_rate"] == 0.0
+
+    def test_rates_from_counter_deltas(self):
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), windows_s=(60.0,), registry=r,
+            min_sample_gap_s=0.0,
+        )
+        mon.sample(now=0.0)
+        r.counter("znicz_serve_requests_submitted_total", "req").inc(10)
+        r.counter(
+            "znicz_serve_requests_retired_total", "ret", ("reason",)
+        ).labels(reason="error").inc(2)
+        r.counter(
+            "znicz_serve_requests_retired_total", "ret", ("reason",)
+        ).labels(reason="eos").inc(8)  # not an error
+        r.counter(
+            "znicz_serve_rejected_total", "rej", ("reason",)
+        ).labels(reason="queue_full").inc(5)
+        r.counter("znicz_serve_deadline_exceeded_total", "dl").inc(1)
+        row = mon.snapshot(now=30.0)["rates"]["60"]
+        assert row["requests"] == 10.0
+        assert row["errors"] == 2.0
+        assert row["sheds"] == 5.0
+        assert row["deadlines"] == 1.0
+        assert row["error_rate"] == pytest.approx(3.0 / 15.0)
+        assert row["shed_rate"] == pytest.approx(5.0 / 15.0)
+
+    def test_error_rate_saturates_when_deaths_outnumber_submits(self):
+        # a wedged engine tick: requests die by deadline in the
+        # FRONT-DOOR pending queue, never reaching engine submit —
+        # error_rate must saturate at 1.0, not report 5000%
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), windows_s=(60.0,), registry=r,
+            min_sample_gap_s=0.0,
+        )
+        mon.sample(now=0.0)
+        r.counter("znicz_serve_deadline_exceeded_total", "dl").inc(50)
+        row = mon.snapshot(now=30.0)["rates"]["60"]
+        assert row["requests"] == 0.0
+        assert row["deadlines"] == 50.0
+        assert row["error_rate"] == 1.0
+
+    def test_maybe_sample_respects_gap(self):
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), registry=r, min_sample_gap_s=5.0
+        )
+        assert mon.maybe_sample(now=0.0) is True
+        assert mon.maybe_sample(now=3.0) is False
+        assert mon.maybe_sample(now=6.0) is True
+
+    def test_snapshot_is_json_able(self):
+        r = _reg()
+        mon = SLOMonitor(targets=(TT,), registry=r, min_sample_gap_s=0.0)
+        _observe(r, "znicz_serve_ttft_seconds", [0.01, 0.2])
+        mon.sample(now=0.0)
+        json.dumps(mon.snapshot(now=1.0))
+
+    def test_snapshot_concurrent_with_sample_is_safe(self):
+        # /slo runs snapshot() on an HTTP worker thread while the
+        # engine thread samples — iterating the live deque raised
+        # "deque mutated during iteration" before the ring lock
+        import threading
+
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), windows_s=(1e9,), registry=r,
+            min_sample_gap_s=0.0,
+        )
+        for i in range(512):  # long ring -> long snapshot iteration
+            mon.sample(now=float(i))
+        errors = []
+        stop = threading.Event()
+
+        def sampler():
+            t = 512.0
+            while not stop.is_set():
+                try:
+                    mon.sample(now=t)
+                except Exception as exc:  # pragma: no cover - fail path
+                    errors.append(exc)
+                    return
+                t += 1.0
+
+        th = threading.Thread(target=sampler, daemon=True)
+        th.start()
+        try:
+            for _ in range(200):
+                mon.snapshot(now=1e6)
+        finally:
+            stop.set()
+            th.join(timeout=10.0)
+        assert errors == []
+
+
+class TestLifetimeAndCLI:
+    def test_lifetime_snapshot_marks_breach(self):
+        r = _reg()
+        _observe(r, "znicz_serve_ttft_seconds", [0.2] * 9 + [0.001])
+        snap = slo_mod.lifetime_snapshot(r, targets=(TT,))
+        ev = snap["targets"]["ttft"]["windows"]["lifetime"]
+        assert ev["n"] == 10.0
+        assert snap["targets"]["ttft"]["breached"] is True
+        assert snap["type"] == "slo"
+
+    def test_evaluate_exposition_round_trip(self):
+        r = _reg()
+        _observe(r, "znicz_serve_ttft_seconds", [0.001] * 10)
+        snap = slo_mod.evaluate_exposition(
+            r.prometheus_text(), targets=(TT,)
+        )
+        ev = snap["targets"]["ttft"]["windows"]["lifetime"]
+        assert ev["n"] == 10.0
+        assert snap["breached"] is False
+        with pytest.raises(ValueError):
+            slo_mod.evaluate_exposition("garbage { exposition")
+
+    def test_cli_exit_codes_and_table(self, tmp_path, capsys):
+        r = _reg()
+        _observe(r, "znicz_serve_ttft_seconds", [0.001] * 10)
+        _observe(
+            r, "znicz_serve_request_latency_seconds", [0.01] * 10,
+            requests=0,
+        )
+        prom = tmp_path / "metrics.prom"
+        prom.write_text(r.prometheus_text())
+        assert slo_mod.main([str(prom)]) == 0
+        out = capsys.readouterr().out
+        assert "ttft" in out and "ok" in out
+        # tighten the objective until the same file breaches
+        assert (
+            slo_mod.main([str(prom), "--ttft", "0.0001"]) == 1
+        )
+        assert "BREACH" in capsys.readouterr().out
+
+    def test_cli_json_mode_and_usage_errors(self, tmp_path, capsys):
+        r = _reg()
+        prom = tmp_path / "metrics.prom"
+        prom.write_text(r.prometheus_text())
+        assert slo_mod.main([str(prom), "--json"]) == 0
+        json.loads(capsys.readouterr().out)
+        assert slo_mod.main([]) == 2
+        assert slo_mod.main([str(prom), "--ttft"]) == 2
+        assert slo_mod.main([str(tmp_path / "missing.prom")]) == 2
+
+    def test_cli_frontdoor_flag_judges_client_clock_series(
+        self, tmp_path, capsys
+    ):
+        # a queue-wait-dominated replica: engine-clock TTFT healthy,
+        # client-clock (front-door) TTFT blown — only --frontdoor
+        # lets the CI gate see what /slo on the replica judges
+        r = MetricsRegistry()
+        fams = {
+            "znicz_serve_ttft_seconds": 0.001,
+            "znicz_serve_request_latency_seconds": 0.01,
+            "znicz_serve_frontdoor_ttft_seconds": 10.0,
+            "znicz_serve_frontdoor_latency_seconds": 10.5,
+        }
+        for name, v in fams.items():
+            h = r.histogram(name, name)
+            for _ in range(10):
+                h.observe(v)
+        prom = tmp_path / "metrics.prom"
+        prom.write_text(r.prometheus_text())
+        assert slo_mod.main([str(prom)]) == 0  # engine clock: all ok
+        capsys.readouterr()
+        assert slo_mod.main([str(prom), "--frontdoor"]) == 1
+        out = capsys.readouterr().out
+        assert "BREACH" in out
+        assert slo_mod.main([str(prom), "--frontdoor", "--json"]) == 1
+        snap = json.loads(capsys.readouterr().out)
+        assert (
+            snap["targets"]["ttft"]["metric"]
+            == "znicz_serve_frontdoor_ttft_seconds"
+        )
+
+    def test_cli_reads_aggregator_url(self, tmp_path):
+        import threading
+
+        from znicz_tpu.observability.aggregate import (
+            build_aggregator_server,
+        )
+
+        r = _reg()
+        _observe(r, "znicz_serve_ttft_seconds", [0.001] * 5)
+        server = build_aggregator_server(port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            server.aggregator.push("a", r.snapshot())
+            port = server.server_address[1]
+            assert slo_mod.main([f"http://127.0.0.1:{port}"]) == 0
+        finally:
+            server.shutdown()
+            server.server_close()

@@ -54,6 +54,7 @@ from znicz_tpu.analysis.rules import (  # noqa: E402,F401
     exceptions,
     host_effects,
     host_sync,
+    metric_names,
     mutable_state,
     prng_keys,
     sharding_axes,

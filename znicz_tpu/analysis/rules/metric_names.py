@@ -1,0 +1,109 @@
+"""ZNC011: dynamically-constructed metric names.
+
+A metric NAME is an identity: dashboards, alerts, the aggregator's
+fleet merge and the SLO monitor all key on it.  Building one at a call
+site from runtime values — ``counter(f"znicz_{kind}_total")``,
+``gauge("znicz_" + name)``, ``histogram("znicz_%s_seconds" % phase)`` —
+turns every distinct value into a NEW metric family: unbounded
+exposition growth (the per-metric series cap doesn't see it — each name
+is its own metric), series no query can aggregate over, and a fleet
+merge that treats re-spellings of the same thing as different things.
+The registry's own design says where the value belongs: a **label** on
+one statically-named family (labels are capped, mergeable and
+queryable).
+
+The rule flags a call to ``counter`` / ``gauge`` / ``histogram`` —
+bare or as an attribute (``observability.counter``,
+``registry.histogram``, ``self._registry.counter``) — whose name
+argument is PROVABLY dynamic text:
+
+* an f-string with at least one interpolation,
+* a ``+`` / ``%`` expression with a string literal (or f-string) on
+  either side,
+* a ``"...".format(...)`` call.
+
+A plain variable stays quiet (its value may well be a static constant
+— e.g. ``PhaseTimer`` passing its ``metric`` parameter through); the
+rule targets the call sites where the dynamism is visible.  A genuine
+exception is exempted inline with ``# znicz-check: disable=ZNC011``
+and a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from znicz_tpu.analysis.rules import Rule, register
+
+_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def _stringish(node: ast.AST) -> bool:
+    """A node that is definitely a str at runtime."""
+    return (
+        isinstance(node, ast.Constant) and isinstance(node.value, str)
+    ) or isinstance(node, ast.JoinedStr)
+
+
+def _dynamic_name(node: ast.AST) -> bool:
+    """Provably runtime-constructed text."""
+    if isinstance(node, ast.JoinedStr):
+        return any(
+            isinstance(v, ast.FormattedValue) for v in node.values
+        )
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Mod)
+    ):
+        return _stringish(node.left) or _stringish(node.right)
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+        and _stringish(node.func.value)
+    ):
+        return True
+    return False
+
+
+@register
+class DynamicMetricNameRule(Rule):
+    id = "ZNC011"
+    severity = "warning"
+    title = (
+        "dynamically-constructed metric name (unbounded families: put "
+        "the varying value in a label, keep the name static)"
+    )
+
+    def check(self, info) -> Iterable:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                callee = func.attr
+            elif isinstance(func, ast.Name):
+                callee = func.id
+            else:
+                continue
+            if callee not in _FACTORIES:
+                continue
+            name_arg = None
+            if node.args:
+                name_arg = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_arg = kw.value
+                        break
+            if name_arg is None or not _dynamic_name(name_arg):
+                continue
+            yield self.finding(
+                info,
+                node,
+                f"{callee}() name is built at runtime — every distinct "
+                "value becomes a new uncapped metric family that "
+                "nothing can aggregate; use a static name with the "
+                "value as a label (labels are cardinality-capped and "
+                "fleet-mergeable), or pragma-exempt with a reason",
+            )

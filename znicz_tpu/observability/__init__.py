@@ -16,18 +16,38 @@ generate serve-cache counters, StatusWriter's timing dict):
 * **PhaseTimer** (:mod:`phases`) — StepTimer-compatible phase timing
   that feeds both.
 
+* **Fleet aggregation** (:mod:`aggregate`) — a MetricsAggregator
+  service replicas push registry snapshots to (instance-tagged,
+  TTL-expired, bucket-wise histogram merge) plus the MetricsPusher
+  background thread feeding it.
+* **SLO monitoring** (:mod:`slo`) — rolling-window p50/p95/p99 and
+  multi-window burn rates over declared targets (``/slo``,
+  ``tools/znicz-slo``).
+
 Convenience module-level ``counter``/``gauge``/``histogram`` operate on
 the default registry; see docs/OBSERVABILITY.md for the metric catalog.
 Pure stdlib at import time — jax is only touched lazily by the tracer.
 """
 
+from znicz_tpu.observability.aggregate import (  # noqa: F401
+    MetricsAggregator,
+    MetricsPusher,
+    build_aggregator_server,
+)
 from znicz_tpu.observability.phases import PhaseTimer  # noqa: F401
 from znicz_tpu.observability.registry import (  # noqa: F401
     DEFAULT_TIME_BUCKETS,
     Metric,
     MetricsRegistry,
+    fraction_le,
     get_registry,
     parse_prometheus_text,
+    quantile_from_cumulative,
+)
+from znicz_tpu.observability.slo import (  # noqa: F401
+    DEFAULT_TARGETS,
+    SLOMonitor,
+    SLOTarget,
 )
 from znicz_tpu.observability.tracing import (  # noqa: F401
     Tracer,

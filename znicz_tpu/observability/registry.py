@@ -42,6 +42,61 @@ DEFAULT_TIME_BUCKETS = (
 )
 
 
+def quantile_from_cumulative(
+    cum: Sequence[Tuple[float, float]], q: float
+) -> Optional[float]:
+    """Bucket-interpolated quantile from ``[(upper_bound,
+    cumulative_count), ...]`` (last pair is the +Inf bucket).  None when
+    empty.  Shared by the live histogram children, the fleet aggregator's
+    merged series, and the SLO monitor's windowed deltas — one
+    interpolation rule everywhere."""
+    if not cum:
+        return None
+    total = cum[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    lo = 0.0
+    prev = 0.0
+    for upper, acc in cum:
+        if acc >= target:
+            if upper == math.inf:
+                return lo  # best finite estimate: last finite edge
+            span = acc - prev
+            frac = (target - prev) / span if span else 1.0
+            return lo + (upper - lo) * frac
+        lo = upper if upper != math.inf else lo
+        prev = acc
+    return lo
+
+
+def fraction_le(
+    cum: Sequence[Tuple[float, float]], threshold: float
+) -> float:
+    """Interpolated fraction of observations <= ``threshold`` from the
+    same cumulative-bucket shape.  1.0 when the series is empty (no
+    evidence of a violation).  The SLO monitor's "good fraction"."""
+    if not cum:
+        return 1.0
+    total = cum[-1][1]
+    if total <= 0:
+        return 1.0
+    lo = 0.0
+    prev = 0.0
+    for upper, acc in cum:
+        if upper >= threshold:
+            if upper == math.inf:
+                # samples past the last finite edge sit above any finite
+                # threshold: count only what is provably below
+                return prev / total
+            span = upper - lo
+            frac = (threshold - lo) / span if span else 1.0
+            return (prev + frac * (acc - prev)) / total
+        lo = upper
+        prev = acc
+    return 1.0
+
+
 def _fmt_value(v: float) -> str:
     if v == math.inf:
         return "+Inf"
@@ -148,23 +203,7 @@ class _HistogramChild:
 
     def quantile(self, q: float) -> Optional[float]:
         """Bucket-interpolated quantile estimate (None when empty)."""
-        cum = self.cumulative()
-        total = cum[-1][1]
-        if total == 0:
-            return None
-        target = q * total
-        lo = 0.0
-        prev = 0
-        for upper, acc in cum:
-            if acc >= target:
-                if upper == math.inf:
-                    return lo  # best finite estimate: last finite edge
-                span = acc - prev
-                frac = (target - prev) / span if span else 1.0
-                return lo + (upper - lo) * frac
-            lo = upper if upper != math.inf else lo
-            prev = acc
-        return lo
+        return quantile_from_cumulative(self.cumulative(), q)
 
 
 class Metric:

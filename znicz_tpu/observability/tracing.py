@@ -31,6 +31,11 @@ logger = logging.getLogger(__name__)
 
 _UNSET = object()
 
+# default size cap for a STREAMED trace file: on a long-running server
+# the stream is otherwise unbounded (the in-memory buffer is capped,
+# the file deliberately is not truncated — so it must rotate instead)
+TRACE_FILE_MAX_BYTES = 256 * 1024 * 1024
+
 
 class Tracer:
     """Nested host-span recorder with Chrome trace-event export.
@@ -51,31 +56,53 @@ class Tracer:
         self._events: List[dict] = []
         self._recording = False
         self._file = None
+        self._path: Optional[str] = None
+        self._file_bytes = 0
+        self._max_file_bytes = 0
         self._t0 = time.perf_counter()
         self._max_events = max_events
         self.dropped = 0
+        self.rotations = 0
         self._annotation = _UNSET
 
     @property
     def recording(self) -> bool:
         return self._recording
 
-    def start(self, path: Optional[str] = None) -> None:
+    def start(
+        self,
+        path: Optional[str] = None,
+        *,
+        max_file_bytes: Optional[int] = TRACE_FILE_MAX_BYTES,
+    ) -> None:
         """Begin recording (optionally streaming each event to ``path``
-        as one JSON object per line).  Clears any previous events."""
+        as one JSON object per line).  Clears any previous events.
+
+        The streamed file is SIZE-CAPPED at ``max_file_bytes``
+        (``None``/``0`` disables): when a write would cross the cap the
+        file rotates — the current file becomes ``<path>.1``
+        (overwriting any previous rotation) and streaming continues
+        into a fresh ``<path>`` — so a long-running server keeps at
+        most ~two caps of trace on disk, newest window always in
+        ``<path>``."""
         with self._lock:
             if self._recording:
                 raise RuntimeError("tracer is already recording")
             self._events = []
             self.dropped = 0
+            self.rotations = 0
             self._t0 = time.perf_counter()
+            self._path = path
             self._file = open(path, "w") if path else None
+            self._file_bytes = 0
+            self._max_file_bytes = int(max_file_bytes or 0)
             self._recording = True
 
     def stop(self) -> List[dict]:
         """Stop recording; returns (and keeps) the event list.  When the
         in-memory buffer overflowed, says so — the streamed JSONL file
-        (if any) is still complete."""
+        (if any) holds every event since its last rotation (older
+        generations beyond ``<path>.1`` rotate away)."""
         with self._lock:
             self._recording = False
             if self._file is not None:
@@ -84,9 +111,11 @@ class Tracer:
             if self.dropped:
                 logger.warning(
                     "tracer buffer dropped %d events past max_events=%d;"
-                    " the streamed JSONL file (if any) is complete",
+                    " the streamed JSONL file (if any) is complete back"
+                    " to its last rotation (%d rotations)",
                     self.dropped,
                     self._max_events,
+                    self.rotations,
                 )
             return list(self._events)
 
@@ -116,15 +145,58 @@ class Tracer:
             if not self._recording:
                 return  # span outlived a stop(): drop, don't corrupt
             # the file streams EVERY event (disk is the durable record);
-            # only the in-memory buffer is capped
+            # only the in-memory buffer is capped — the file instead
+            # ROTATES at max_file_bytes so a long-running server's
+            # trace stays bounded without losing the newest window
             if self._file is not None:
-                self._file.write(
-                    json.dumps(ev, separators=(",", ":")) + "\n"
-                )
+                # ensure_ascii JSON is pure ASCII, so len(line) IS the
+                # on-disk byte count the rotation cap accounts against
+                line = json.dumps(ev, separators=(",", ":")) + "\n"
+                if (
+                    self._max_file_bytes
+                    and self._file_bytes
+                    and self._file_bytes + len(line) > self._max_file_bytes
+                ):
+                    self._rotate_locked()
+                if self._file is not None:
+                    # a doubly-failed rotation (rename AND reopen) drops
+                    # the stream: memory-buffer-only from here
+                    self._file.write(line)
+                    self._file_bytes += len(line)
             if len(self._events) >= self._max_events:
                 self.dropped += 1
                 return
             self._events.append(ev)
+
+    def _rotate_locked(self) -> None:
+        """Close the streamed file, shift it to ``<path>.1`` and reopen
+        ``<path>`` (lock held by the caller).  A failed rename keeps
+        streaming into the grown file — rotation is best-effort, the
+        trace must never take the server down."""
+        try:
+            self._file.close()
+            os.replace(self._path, self._path + ".1")
+            self._file = open(self._path, "w")
+            self._file_bytes = 0
+            self.rotations += 1
+        except OSError:
+            logger.warning(
+                "trace rotation of %s failed; stream continues uncapped",
+                self._path, exc_info=True,
+            )
+            self._max_file_bytes = 0
+            if self._file.closed:  # reopen in append: keep streaming
+                try:
+                    self._file = open(self._path, "a")
+                except OSError:
+                    # the path itself is gone (dir deleted, EROFS):
+                    # degrade to the in-memory buffer — the trace must
+                    # never take the instrumented thread down
+                    logger.warning(
+                        "trace stream %s lost; buffering in memory only",
+                        self._path, exc_info=True,
+                    )
+                    self._file = None
 
     def _annotation_cls(self):
         """``jax.profiler.TraceAnnotation`` when jax is importable, else

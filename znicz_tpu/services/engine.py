@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import time
 from collections import OrderedDict, deque
 from functools import partial
 from typing import Deque, Dict, List, Optional, Sequence
@@ -93,6 +94,42 @@ _PREFIX_SEED = b"znicz-prefix-v1"
 
 
 @dataclasses.dataclass
+class RequestTimings:
+    """Per-request lifecycle breakdown — the answer to "why was this
+    request slow", attached to every :class:`Completion` (and the HTTP
+    done record).  All host wall-clock (``time.perf_counter`` deltas):
+
+    * ``queue_s`` — time spent WAITING (engine queue before first
+      admission, plus every re-queue wait after a preemption; the
+      front door adds its own pending-queue wait on top).
+    * ``prefill_s`` — wall time of this request's own admit/prefill
+      program calls (per-chunk on the paged backend).
+    * ``decode_s`` — wall time of the decode chunks this request was
+      RESIDENT in.  Chunks are batched, so concurrent residents each
+      count the full chunk — a per-request share of shared tower work,
+      not a sum that totals to wall time across requests.
+    * ``preemptions`` — times this request was evicted and recomputed.
+    * ``cached_tokens`` — prompt tokens whose prefill was skipped via
+      the prefix cache (accumulated across re-admissions).
+    """
+
+    queue_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    preemptions: int = 0
+    cached_tokens: int = 0
+
+    def as_dict(self) -> Dict:
+        return {
+            "queue_s": round(self.queue_s, 6),
+            "prefill_s": round(self.prefill_s, 6),
+            "decode_s": round(self.decode_s, 6),
+            "preemptions": self.preemptions,
+            "cached_tokens": self.cached_tokens,
+        }
+
+
+@dataclasses.dataclass
 class Request:
     """One queued generation request: a 1-D prompt with its own budget."""
 
@@ -105,6 +142,15 @@ class Request:
     # memoized prefix-cache hash chain (pure function of the prompt —
     # computed once per request; block RESOLUTION stays per-tick fresh)
     digests: Optional[List[bytes]] = None
+    # end-to-end tracing: the client-visible id (set by the front door)
+    # and the lifecycle breakdown this request accumulates
+    trace_id: Optional[str] = None
+    timings: RequestTimings = dataclasses.field(
+        default_factory=RequestTimings
+    )
+    # watch-relative instant this request last (re-)entered the queue:
+    # 0.0 at submit, bumped at preemption — queue_s accrues from here
+    last_queued_at: float = 0.0
 
 
 @dataclasses.dataclass
@@ -133,6 +179,9 @@ class Completion:
     ttft_s: Optional[float] = None
     error: Optional[str] = None  # set for finish_reason == "error"
     trace_id: Optional[str] = None  # front-door request id
+    # per-request lifecycle breakdown (RequestTimings.as_dict():
+    # queue_s / prefill_s / decode_s / preemptions / cached_tokens)
+    timings: Optional[Dict] = None
 
 
 def _sample_tok(logits, key, temperature, top_p, *, greedy, top_k, nucleus):
@@ -527,10 +576,18 @@ class DecodeEngine:
             )
         return bucket
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        trace_id: Optional[str] = None,
+    ) -> int:
         """Queue one prompt (1-D token ids); returns the request id.
         Validated against the active backend's real KV capacity, so
-        admission can never fail later."""
+        admission can never fail later.  ``trace_id`` (the front door's
+        client-visible id) rides into the request's lifecycle spans and
+        its completion."""
         p = np.asarray(prompt, np.int32).reshape(-1)
         if p.size == 0:
             raise ValueError("empty prompt")
@@ -541,11 +598,20 @@ class DecodeEngine:
         self._next_id += 1
         self._queue.append(
             Request(rid, p, int(max_new_tokens), bucket,
-                    profiling.Stopwatch())
+                    profiling.Stopwatch(), trace_id=trace_id)
         )
         self._m_submitted.inc()
         self._m_queue_depth.set(len(self._queue))
+        observability.instant(
+            "serve/queued", id=rid, **self._trace_args(trace_id)
+        )
         return rid
+
+    @staticmethod
+    def _trace_args(trace_id: Optional[str]) -> Dict:
+        """Span/instant args for a trace id — empty when none, so
+        engine-direct callers add no noise to the timeline."""
+        return {"trace": trace_id} if trace_id else {}
 
     @property
     def pending(self) -> int:
@@ -609,7 +675,12 @@ class DecodeEngine:
         self._m_active.set(self.active)
 
     def _admit_into(self, slot: int, req: Request) -> None:
-        with self.timer.phase("admit", request=req.id, bucket=req.bucket):
+        req.timings.queue_s += req.watch.elapsed() - req.last_queued_at
+        t0 = time.perf_counter()
+        with self.timer.phase(
+            "admit", request=req.id, bucket=req.bucket,
+            **self._trace_args(req.trace_id),
+        ):
             tokens, start = pack_prompts(
                 [req.prompt], req.bucket, self.pad_id
             )
@@ -625,6 +696,7 @@ class DecodeEngine:
                 moe_dispatch=self.moe_dispatch,
             )
             first = int(first)
+        req.timings.prefill_s += time.perf_counter() - t0
         self._m_admitted.inc()
         req.ttft_s = req.watch.elapsed()
         self._m_ttft.observe(req.ttft_s)
@@ -643,6 +715,10 @@ class DecodeEngine:
     def _run_chunk(self) -> None:
         faults.fire("engine.decode_step")
         self._peak_active = max(self._peak_active, self.active)
+        residents = [
+            st["req"] for st in self._slots if st is not None
+        ]
+        t0 = time.perf_counter()
         with self.timer.phase("decode", active=self.active):
             rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
             self._chunk_idx += 1
@@ -673,6 +749,9 @@ class DecodeEngine:
             self._pos = np.array(pos)
             self._done = np.array(done)
             self._remaining = np.array(remaining)
+        dt = time.perf_counter() - t0
+        for r in residents:
+            r.timings.decode_s += dt
         for slot, st in enumerate(self._slots):
             if st is None:
                 continue
@@ -706,6 +785,8 @@ class DecodeEngine:
             tokens_per_sec=len(emitted) / max(dt, 1e-9),
             bucket=req.bucket,
             ttft_s=req.ttft_s,
+            trace_id=req.trace_id,
+            timings=req.timings.as_dict(),
         )
         self._order.append(comp)
         self.completions[req.id] = comp
@@ -714,6 +795,10 @@ class DecodeEngine:
         self._total_new += len(emitted)
         self._m_retired.labels(reason=reason).inc()
         self._m_tokens.inc(len(emitted))
+        observability.instant(
+            "serve/retired", id=req.id, reason=reason,
+            **self._trace_args(req.trace_id),
+        )
 
     # -- out-of-band retirement (cancellation / deadlines) ----------------
 
@@ -733,6 +818,11 @@ class DecodeEngine:
             if req.id == request_id:
                 del self._queue[i]
                 self._m_queue_depth.set(len(self._queue))
+                # the whole wait so far was queueing: close it out so
+                # the timings of a queued abort say where the time went
+                req.timings.queue_s += (
+                    req.watch.elapsed() - req.last_queued_at
+                )
                 self._retire(req, [], reason)
                 return self.completions[request_id]
         for slot, st in enumerate(self._slots):
@@ -1112,9 +1202,16 @@ class PagedDecodeEngine(DecodeEngine):
         self._pos[slot] = 0
         self._start[slot] = 0
         self._queue.appendleft(st["req"])
+        req = st["req"]
+        req.timings.preemptions += 1
+        req.last_queued_at = req.watch.elapsed()
         self._n_preempted += 1
         self._m_preempted.inc()
         self._m_queue_depth.set(len(self._queue))
+        observability.instant(
+            "serve/preempt", id=req.id,
+            **self._trace_args(req.trace_id),
+        )
 
     def _ensure_blocks(self, slot: int, need: int) -> bool:
         """Grow ``slot``'s table to >= ``need`` blocks, preempting the
@@ -1300,6 +1397,7 @@ class PagedDecodeEngine(DecodeEngine):
         final chunk to the block boundary — the prefix-cache alignment
         contract (see :func:`~znicz_tpu.workflow.generate
         .paged_prefill_chunk`)."""
+        req.timings.queue_s += req.watch.elapsed() - req.last_queued_at
         size = req.prompt.size
         tokens = np.full((1, req.bucket), self.pad_id, np.int32)
         tokens[0, :size] = req.prompt
@@ -1323,6 +1421,7 @@ class PagedDecodeEngine(DecodeEngine):
             if hits and len(hits) * self.block_size == size
             else len(hits)
         )
+        req.timings.cached_tokens += skip * self.block_size
         if self.prefix_cache:
             n_lookup = size // self.block_size
             self._n_prefix_hits += len(hits)
@@ -1386,11 +1485,13 @@ class PagedDecodeEngine(DecodeEngine):
         # admitted/TTFT series) exact under preemption
         first_time = req.id not in self._admitted_ids
         greedy, top_k, nucleus = self._structure
+        t0 = time.perf_counter()
         # the LAST chunk is the admission event (first token sampled);
         # earlier chunks trace as serve/prefill
         with self.timer.phase(
             "admit" if last and first_time else "prefill",
             request=req.id, bucket=req.bucket, chunk=c,
+            **self._trace_args(req.trace_id),
         ):
             self._program(("prefill", self.block_size, self._structure))
             key = jax.random.fold_in(self._rng, st["seq"])
@@ -1418,6 +1519,7 @@ class PagedDecodeEngine(DecodeEngine):
             st["chunks_done"] = c + 1
             if last:
                 first = int(first)  # host sync only at admission
+        req.timings.prefill_s += time.perf_counter() - t0
         self._m_prefill_chunks.inc()
         if not last:
             return True
@@ -1532,6 +1634,11 @@ class PagedDecodeEngine(DecodeEngine):
         while window < need:
             window *= 2
         window = min(window, self.blocks_per_row)
+        residents = [
+            s["req"] for s in self._slots
+            if s is not None and s["mode"] == "decode"
+        ]
+        t0 = time.perf_counter()
         with self.timer.phase("decode", active=self.active):
             rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
             self._chunk_idx += 1
@@ -1562,6 +1669,9 @@ class PagedDecodeEngine(DecodeEngine):
             self._pos = np.array(pos)
             self._done = np.array(done)
             self._remaining = np.array(remaining)
+        dt = time.perf_counter() - t0
+        for r in residents:
+            r.timings.decode_s += dt
         for slot, st in enumerate(self._slots):
             if st is None or st["mode"] != "decode":
                 continue

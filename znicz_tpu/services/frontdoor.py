@@ -68,7 +68,13 @@ from typing import Callable, Dict, Iterator, List, Optional, Set
 import numpy as np
 
 from znicz_tpu import observability
-from znicz_tpu.services.engine import Completion, DecodeEngine
+from znicz_tpu.observability.aggregate import MetricsPusher
+from znicz_tpu.observability.slo import FRONTDOOR_TARGETS, SLOMonitor
+from znicz_tpu.services.engine import (
+    Completion,
+    DecodeEngine,
+    RequestTimings,
+)
 from znicz_tpu.services.errors import (
     EngineClosedError,
     RejectedError,
@@ -169,6 +175,10 @@ class _FrontRequest:
     streamed: int = 0  # emitted tokens already pushed to the handle
     tokens: List[int] = dataclasses.field(default_factory=list)
     ttft_s: Optional[float] = None  # first token seen (front-door clock)
+    # time spent in the FRONT DOOR's pending queue before the engine
+    # took it — added to the completion's queue_s (the engine's own
+    # queue accounting starts at engine submit)
+    pending_wait_s: float = 0.0
 
 
 class ServingFrontDoor:
@@ -208,6 +218,13 @@ class ServingFrontDoor:
         engine_queue_limit: Optional[int] = None,
         retry_after_s: float = 1.0,
         name: str = "znicz",
+        debug_requests: int = 64,
+        slo_targets=None,
+        slo_windows_s=None,
+        slo_sample_gap_s: float = 5.0,
+        aggregator_url: Optional[str] = None,
+        instance: Optional[str] = None,
+        push_interval_s: float = 15.0,
     ):
         if max_pending < 1:
             raise ValueError(f"want max_pending >= 1; got {max_pending}")
@@ -243,6 +260,40 @@ class ServingFrontDoor:
         # unique across restarts of the whole process
         self._ids = itertools.count()
         self._suffix = os.urandom(3).hex()
+        # /debug/requests ring: the last K request summaries (newest
+        # last), appended by the engine thread, read under the lock
+        self._recent: "deque" = deque(maxlen=max(int(debug_requests), 1))
+        # SLO judgment over the process registry: the engine thread
+        # samples it on a bounded cadence so /slo always has rolling
+        # windows to evaluate (docs/OBSERVABILITY.md "SLOs")
+        slo_kw = {
+            "min_sample_gap_s": float(slo_sample_gap_s),
+            # default: the client-clock front-door histograms (what
+            # znicz-slo --frontdoor gates on), not the engine's own —
+            # those start at ENGINE submit and cannot see a deep
+            # pending queue or a wedged tick
+            "targets": (
+                slo_targets
+                if slo_targets is not None
+                else FRONTDOOR_TARGETS
+            ),
+        }
+        if slo_windows_s is not None:
+            slo_kw["windows_s"] = slo_windows_s
+        self._slo = SLOMonitor(**slo_kw)
+        # pristine baseline at door creation: the very first request's
+        # observations must be visible as a DELTA against something
+        # (the per-tick sample lands only at the end of a tick)
+        self._slo.sample()
+        # fleet aggregation: push this process's registry to a
+        # MetricsAggregator so N replicas land in one /metrics
+        self._pusher: Optional[MetricsPusher] = None
+        if aggregator_url:
+            self._pusher = MetricsPusher(
+                aggregator_url,
+                instance=instance or f"{name}-{self._suffix}",
+                interval_s=push_interval_s,
+            ).start()
         # per-instance tallies (the registry counters are process-wide)
         self._n_submitted = 0
         self._n_completed = 0
@@ -268,6 +319,16 @@ class ServingFrontDoor:
             "znicz_serve_watchdog_restarts_total",
             "engine rebuilds after an engine-thread exception",
         )
+        # same family the engine retires into (get-or-create): the
+        # front door is the ONLY writer of reason="error" — crash/
+        # submit-failed requests bypass the engine's _retire, and
+        # /slo's error_rate reads exactly this series; without it a
+        # crash incident would be invisible to the SLO gate
+        self._m_retired = observability.counter(
+            "znicz_serve_requests_retired_total",
+            "completed requests by finish reason",
+            ("reason",),
+        )
         self._m_pending = observability.gauge(
             "znicz_serve_frontdoor_pending",
             "requests waiting in the front-door queue",
@@ -279,6 +340,18 @@ class ServingFrontDoor:
         self._m_inflight = observability.gauge(
             "znicz_serve_frontdoor_inflight",
             "requests handed to the engine and not yet completed",
+        )
+        # CLIENT-clock histograms: submit -> first streamed token /
+        # completion, front-door queueing and tick cadence included —
+        # what the SLO targets judge (the engine's own ttft/latency
+        # series start at ENGINE submit and miss both)
+        self._m_fd_ttft = observability.histogram(
+            "znicz_serve_frontdoor_ttft_seconds",
+            "front-door submit -> first streamed token (client clock)",
+        )
+        self._m_fd_latency = observability.histogram(
+            "znicz_serve_frontdoor_latency_seconds",
+            "front-door submit -> completion delivery (client clock)",
         )
         self._thread = threading.Thread(
             target=self._serve_loop, name=f"{name}-frontdoor", daemon=True
@@ -420,6 +493,10 @@ class ServingFrontDoor:
                 "front door engine thread failed to stop (stalled tick?)"
             )
         self._closed = True
+        if self._pusher is not None:
+            # final flush AFTER the drain: the aggregator's last view of
+            # this instance includes the shutdown-path counters
+            self._pusher.stop()
 
     def __enter__(self) -> "ServingFrontDoor":
         return self
@@ -467,6 +544,22 @@ class ServingFrontDoor:
 
     def healthy(self) -> bool:
         return self.watchdog_state()["state"] == "running"
+
+    def slo_snapshot(self) -> Dict:
+        """Rolling SLO judgment (``GET /slo`` body, and the input the
+        SLO-aware-scheduling rung consumes): per-target p50/p95/p99 and
+        multi-window burn rates over the TTFT/latency histograms, plus
+        request/error/shed rates.  Thread-safe — evaluation reads the
+        registry and the monitor's sample ring, never engine state."""
+        return self._slo.snapshot()
+
+    def recent_requests(self) -> List[Dict]:
+        """The ``/debug/requests`` ring: the last K completed request
+        summaries, NEWEST FIRST — trace id, finish reason, latency,
+        TTFT and the queue/prefill/decode timings breakdown.  Live
+        debugging surface; bounded, so safe to poll."""
+        with self._lock:
+            return list(reversed(self._recent))
 
     def stats(self) -> Dict:
         """Front-door report: the admission/termination tallies plus
@@ -518,6 +611,7 @@ class ServingFrontDoor:
                     eng._run_chunk()
             self._stream_and_collect()
             self._publish_gauges()
+            self._slo.maybe_sample()
         finally:
             self._last_tick = time.monotonic()
             self._tick_started = None
@@ -591,8 +685,11 @@ class ServingFrontDoor:
                 if not self._pending or eng.pending >= self.engine_queue_limit:
                     break
                 fr = self._pending.popleft()
+            fr.pending_wait_s = fr.watch.elapsed()
             try:
-                rid = eng.submit(fr.prompt, fr.max_new_tokens)
+                rid = eng.submit(
+                    fr.prompt, fr.max_new_tokens, trace_id=fr.trace_id
+                )
             except Exception as exc:
                 # pre-validated, so only config drift after a restart
                 # can land here; typed error, never a hung handle
@@ -662,11 +759,58 @@ class ServingFrontDoor:
             fr.handle._q.put(int(t))
         if comp.ttft_s is None:
             comp.ttft_s = fr.ttft_s
+        # client-clock series (the SLO inputs): only the front-door
+        # first-token instant — never the engine's admission-time ttft,
+        # which a request aborted after a preemption (tokens reconciled
+        # away, nothing ever streamed) would otherwise leak here,
+        # recording a tiny engine-clock ttft for a request that sat in
+        # the pending queue the whole time.  Client cancels, shutdown
+        # sheds and engine-crash errors are not latency measurements —
+        # a flood of fast cancels (or a burst of requests error-failed
+        # 0.2s in by a crash) mid-incident must not dilute bad_frac
+        # below a real breach (those fates are judged via the
+        # cancelled/rejected/error rate counters instead; deadline
+        # expiries DO count — they are genuinely slow requests)
+        if comp.finish_reason not in (
+            REASON_CANCELLED, REASON_SHED, REASON_ERROR
+        ):
+            self._m_fd_latency.observe(fr.watch.elapsed())
+        if fr.ttft_s is not None:
+            self._m_fd_ttft.observe(fr.ttft_s)
+        # every completion carries the lifecycle breakdown: the engine's
+        # own accounting plus the FRONT-DOOR pending wait (a request that
+        # never reached the engine is pure queue time)
+        if comp.timings is None:
+            comp.timings = RequestTimings(
+                queue_s=fr.watch.elapsed()
+            ).as_dict()
+        else:
+            comp.timings = dict(comp.timings)
+            comp.timings["queue_s"] = round(
+                comp.timings.get("queue_s", 0.0) + fr.pending_wait_s, 6
+            )
         fr.handle._completion = comp
         fr.handle._done.set()
         fr.handle._q.put(_DONE)
         with self._lock:
             self._by_id.pop(fr.trace_id, None)
+            self._recent.append(
+                {
+                    "trace_id": fr.trace_id,
+                    "finish_reason": comp.finish_reason,
+                    "prompt_len": int(fr.prompt.size),
+                    "n_new": comp.n_new,
+                    "latency_ms": round(1000.0 * fr.watch.elapsed(), 1),
+                    "ttft_ms": (
+                        round(1000.0 * comp.ttft_s, 1)
+                        if comp.ttft_s is not None
+                        else None
+                    ),
+                    "timings": comp.timings,
+                    "error": comp.error,
+                    "done_unix": time.time(),  # timestamp, not a delta
+                }
+            )
         self._n_completed += 1
         if comp.finish_reason == REASON_DEADLINE:
             self._n_deadline += 1
@@ -677,6 +821,8 @@ class ServingFrontDoor:
         elif comp.finish_reason == REASON_SHED:
             self._n_shed += 1
             self._m_rejected.labels(reason="shutdown").inc()
+        elif comp.finish_reason == REASON_ERROR:
+            self._m_retired.labels(reason="error").inc()
         observability.instant(
             "frontdoor/done",
             id=fr.trace_id,
@@ -689,9 +835,14 @@ class ServingFrontDoor:
         fr: _FrontRequest,
         reason: str,
         error: Optional[str] = None,
+        timings: Optional[RequestTimings] = None,
     ) -> Completion:
         """A typed completion for a request the ENGINE cannot speak for
-        (never admitted, or the engine just died)."""
+        (never admitted, or the engine just died).  ``timings`` carries
+        the dead engine's real per-request accounting when the request
+        HAD been admitted — without it, :meth:`_finish` would fabricate
+        a 100%%-queue-wait breakdown for a request that was mid-decode
+        when the engine crashed."""
         dt = fr.watch.elapsed()
         return Completion(
             id=fr.engine_id if fr.engine_id is not None else -1,
@@ -705,6 +856,7 @@ class ServingFrontDoor:
             bucket=0,
             ttft_s=fr.ttft_s,
             error=error,
+            timings=timings.as_dict() if timings is not None else None,
         )
 
     def _engine_failure(self, exc: Exception) -> None:
@@ -725,9 +877,17 @@ class ServingFrontDoor:
                 "post-failure completion sweep failed", exc_info=True
             )
         queued_ids: Set[int] = set()
+        engine_timings: Dict[int, RequestTimings] = {}
         if eng is not None:
             try:
                 queued_ids = {r.id for r in eng._queue}
+                # salvage the dead engine's per-request accounting so
+                # crash-failed completions report their REAL breakdown
+                for r in eng._queue:
+                    engine_timings[r.id] = r.timings
+                for st in eng._slots:
+                    if st is not None:
+                        engine_timings[st["req"].id] = st["req"].timings
             except Exception:
                 logger.warning(
                     "could not read the failed engine's queue; failing "
@@ -740,7 +900,11 @@ class ServingFrontDoor:
                 requeue.append(fr)
             else:
                 self._finish(
-                    fr, self._local_completion(fr, REASON_ERROR, error=msg)
+                    fr,
+                    self._local_completion(
+                        fr, REASON_ERROR, error=msg,
+                        timings=engine_timings.get(rid),
+                    ),
                 )
         self._inflight.clear()
         with self._lock:

@@ -64,6 +64,23 @@ logger = logging.getLogger(__name__)
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 NDJSON_CONTENT_TYPE = "application/x-ndjson"
 
+_SLO_FALLBACK = None
+_SLO_FALLBACK_LOCK = threading.Lock()
+
+
+def _fallback_slo():
+    """Lazy process-local SLO monitor for frontdoor-less servers (the
+    static status-dir case): /slo still answers, evaluated over this
+    process's registry.  Locked: concurrent first polls on a
+    ThreadingHTTPServer must share ONE monitor (and one sample ring)."""
+    global _SLO_FALLBACK
+    with _SLO_FALLBACK_LOCK:
+        if _SLO_FALLBACK is None:
+            from znicz_tpu.observability.slo import SLOMonitor
+
+            _SLO_FALLBACK = SLOMonitor()
+        return _SLO_FALLBACK
+
 
 def _snapshot_from_prom(text: str) -> dict:
     """Sample-level JSON view of a Prometheus exposition: ``{sample_name:
@@ -101,6 +118,31 @@ class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
             self._do_healthz()
+        elif path == "/slo":
+            # the front door's rolling judgment when one is attached;
+            # a plain status server still answers from a process-local
+            # monitor over the live registry
+            fd = self.frontdoor
+            if fd is not None:
+                snap = fd.slo_snapshot()
+            else:
+                # nothing else samples this monitor, so each poll does:
+                # consecutive polls build real rolling windows instead
+                # of judging lifetime totals as if they were 60 s old
+                mon = _fallback_slo()
+                mon.maybe_sample()
+                snap = mon.snapshot()
+            self._send_json(snap)
+        elif path == "/debug/requests":
+            fd = self.frontdoor
+            if fd is None:
+                self._send_json(
+                    {"error": "no_engine",
+                     "detail": "no serving front door attached"},
+                    status=404,
+                )
+            else:
+                self._send_json({"requests": fd.recent_requests()})
         elif path == "/metrics":
             prom = os.path.join(self.directory, "metrics.prom")
             if os.path.exists(prom):
@@ -248,6 +290,14 @@ class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
                     "finish_reason": comp.finish_reason,
                     "n_new": comp.n_new,
                     "latency_ms": round(1000.0 * comp.latency_s, 1),
+                    "ttft_ms": (
+                        round(1000.0 * comp.ttft_s, 1)
+                        if comp.ttft_s is not None
+                        else None
+                    ),
+                    # the per-request lifecycle breakdown: queue_s /
+                    # prefill_s / decode_s / preemptions / cached_tokens
+                    "timings": comp.timings,
                     **(
                         {"error": comp.error}
                         if comp.error is not None
@@ -316,6 +366,17 @@ def shutdown_gracefully(server, frontdoor=None, grace_s: float = 5.0):
     shutdown cannot hang on a slow client."""
     if frontdoor is not None:
         frontdoor.close(drain=True, grace_s=grace_s)
+    # a recording tracer is flushed and closed AFTER the drain, so the
+    # spans of the final requests land in the JSONL file before exit —
+    # a SIGTERM rollout must not truncate the trace (ISSUE 7 satellite)
+    try:
+        from znicz_tpu.observability import get_tracer
+
+        tracer = get_tracer()
+        if tracer.recording:
+            tracer.stop()
+    except Exception:
+        logger.warning("tracer flush on shutdown failed", exc_info=True)
     server.shutdown()
 
 

@@ -39,11 +39,40 @@ def _atomic_write(path: str, text: str) -> None:
 
 
 class StatusWriter:
-    def __init__(self, directory: str, *, refresh_seconds: int = 5):
+    """Per-epoch status/metrics files, optionally also PUSHED to a
+    fleet :class:`~znicz_tpu.observability.aggregate.MetricsAggregator`
+    (``aggregator_url``): the background pusher reports every
+    ``push_interval_s`` and :meth:`on_epoch` flushes synchronously so
+    the fleet view is epoch-fresh.  A dead aggregator costs log lines,
+    never training time beyond the pusher's own bounded timeout."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        refresh_seconds: int = 5,
+        aggregator_url: str = None,
+        instance: str = None,
+        push_interval_s: float = 15.0,
+    ):
         self.directory = directory
         self.refresh_seconds = refresh_seconds
         self._clock = Stopwatch()
         os.makedirs(directory, exist_ok=True)
+        self._pusher = None
+        if aggregator_url:
+            from znicz_tpu.observability.aggregate import MetricsPusher
+
+            self._pusher = MetricsPusher(
+                aggregator_url,
+                instance=instance or f"train-{os.getpid()}",
+                interval_s=push_interval_s,
+            ).start()
+
+    def close(self) -> None:
+        """Stop the aggregator pusher (final flush included)."""
+        if self._pusher is not None:
+            self._pusher.stop()
 
     def on_epoch(self, workflow, verdict) -> None:
         dec = workflow.decision
@@ -83,6 +112,9 @@ class StatusWriter:
             get_registry().prometheus_text(),
         )
         self._write_html(status)
+        if self._pusher is not None:
+            # epoch-fresh fleet view; bounded by the pusher's timeout
+            self._pusher.push_now()
 
     @staticmethod
     def _devices():

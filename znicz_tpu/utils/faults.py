@@ -19,7 +19,11 @@ point                     effect when armed
                           (free list AND cache) — the eviction/preemption/
                           shedding ladder without filling real memory
 ``frontdoor.slow_tick``   sleeps at the top of the engine-thread tick (a
-                          stalled tick: the watchdog-detection path)
+                          stalled tick: the watchdog-detection path;
+                          also how SLO-breach latency is injected)
+``pusher.push``           raises/sleeps inside a MetricsPusher push (a
+                          dead or slow aggregator: the push failure
+                          path — counted, logged, never propagated)
 ========================  ==================================================
 
 Arming::
