@@ -1507,6 +1507,242 @@ def _sec_lm_serve_frontdoor(ctx):
     ]
 
 
+@_section("lm_serve_router")
+def _sec_lm_serve_router(ctx):
+    # MULTI-REPLICA ROUTING (ISSUE 8): the shared-system-prompt stream
+    # of lm_serve_prefix, but MIXED — several prompt FAMILIES, each a
+    # 160-token shared prefix with short per-request tails — replayed
+    # through the real router HTTP surface over TWO in-process
+    # replicas.  Prefix-affinity placement keeps each family on one
+    # replica (one cold prefill per family fleet-wide); the
+    # round-robin baseline splits every family across both replicas
+    # and pays the cold prefill once per replica.  Reported:
+    # lm_serve_router_hit_rate (replica-measured prefix-cache hit
+    # fraction under affinity routing) and
+    # lm_serve_router_ttft_vs_roundrobin (mean client-clock TTFT
+    # ratio, affinity/round-robin — below 1.0 means cache-aware
+    # placement pays on this stream).
+    import http.client
+    import threading
+
+    import numpy as np
+
+    from znicz_tpu.cluster import ServingRouter, build_router_server
+    from znicz_tpu.core import prng
+    from znicz_tpu.services import serve as serve_mod
+    from znicz_tpu.services.engine import PagedDecodeEngine
+    from znicz_tpu.services.frontdoor import ServingFrontDoor
+    from znicz_tpu.workflow.transformer import init_lm_params
+
+    cfg, b = LM_MID, LM_MID_B
+    n_replicas, n_families, per_family = 2, 3, 4
+    budget = 24
+    block = LM_SERVE_PAGED_BLOCK
+    t_max = 384
+    try:
+        prng.seed_all(95)
+        params = init_lm_params(
+            cfg["vocab"], cfg["d_model"], cfg["n_layers"],
+            cfg["n_heads"], max_seq=t_max,
+        )
+        gen = np.random.default_rng(14)
+        families = [
+            gen.integers(1, cfg["vocab"], (LM_PREFIX_SYS,)).astype(
+                np.int32
+            )
+            for _ in range(n_families)
+        ]
+        # interleaved order: family affinity has to survive the other
+        # families' traffic between two same-family requests
+        prompts = [
+            np.concatenate(
+                [
+                    families[f],
+                    gen.integers(1, cfg["vocab"], (16 + 8 * f,)).astype(
+                        np.int32
+                    ),
+                ]
+            )
+            for j in range(per_family)
+            for f in range(n_families)
+        ]
+
+        def one_request(port, prompt):
+            t_req = time.time()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=300
+            )
+            try:
+                conn.request(
+                    "POST", "/generate",
+                    body=json.dumps(
+                        {"prompt": [int(t) for t in prompt],
+                         "max_new_tokens": budget}
+                    ),
+                )
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    resp.read()
+                    return {"status": resp.status}
+                out = {"status": 200, "n_new": 0, "ttft_s": None}
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    rec = json.loads(line)
+                    if "token" in rec:
+                        if out["ttft_s"] is None:
+                            out["ttft_s"] = time.time() - t_req
+                        out["n_new"] += 1
+                    elif rec.get("done"):
+                        out["router"] = rec.get("router", {})
+                return out
+            finally:
+                conn.close()
+
+        def run_policy(policy):
+            # EVERYTHING from the first door on is inside the try: a
+            # mid-setup failure must tear down whatever already
+            # started (engine threads, bound sockets, the heartbeat)
+            # instead of leaking it into the rest of the round
+            doors, srvs = [], []
+            router = rsrv = None
+            try:
+                for _ in range(n_replicas):
+                    door = ServingFrontDoor(
+                        lambda: PagedDecodeEngine(
+                            params, n_heads=cfg["n_heads"], eos_id=0,
+                            batch_size=b, admit_every=8, max_seq=t_max,
+                            block_size=block,
+                        ),
+                        max_pending=2 * len(prompts),
+                    )
+                    doors.append(door)
+                    srv = serve_mod.build_server(
+                        directory=".", port=0, frontdoor=door
+                    )
+                    srvs.append(srv)
+                    threading.Thread(
+                        target=srv.serve_forever, daemon=True
+                    ).start()
+                router = ServingRouter(block_size=block, policy=policy)
+                for i, srv in enumerate(srvs):
+                    router.register(
+                        f"replica-{i}",
+                        f"http://127.0.0.1:{srv.server_address[1]}",
+                    )
+                rsrv = build_router_server(router, port=0)
+                threading.Thread(
+                    target=rsrv.serve_forever, daemon=True
+                ).start()
+                port = rsrv.server_address[1]
+                # sequential replay: per-request TTFT then measures
+                # prefill (cold vs cached), not queueing noise
+                t0 = time.time()
+                results = [one_request(port, p) for p in prompts]
+                wall = time.time() - t0
+                ok = [r for r in results if r.get("status") == 200]
+                ttfts = [
+                    r["ttft_s"] for r in ok
+                    if r.get("ttft_s") is not None
+                ]
+                hits = misses = 0
+                for door in doors:
+                    pc = door.engine.stats()["prefix_cache"]
+                    hits += pc["hits"]
+                    misses += pc["misses"]
+                stats = router.stats()
+                compiles = max(
+                    door.engine.stats().get("n_programs", 0)
+                    for door in doors
+                )
+                return {
+                    "ok": len(ok),
+                    "wall": wall,
+                    "tokens": sum(r.get("n_new", 0) for r in ok),
+                    "mean_ttft": sum(ttfts) / max(len(ttfts), 1),
+                    "hits": hits,
+                    "misses": misses,
+                    "retries": sum(
+                        r.get("router", {}).get("retries", 0)
+                        for r in ok
+                    ),
+                    "replicas_used": len(
+                        {
+                            r.get("router", {}).get("replica")
+                            for r in ok
+                        }
+                    ),
+                    "stats": stats,
+                    "compiles": compiles,
+                }
+            finally:
+                for srv in srvs:
+                    srv.shutdown()
+                    srv.server_close()
+                if rsrv is not None:
+                    rsrv.shutdown()
+                    rsrv.server_close()
+                for door in doors:
+                    door.close(grace_s=10.0)
+                if router is not None:
+                    router.close()
+
+        run_policy("prefix_affinity")  # warm every program through HTTP
+        aff = run_policy("prefix_affinity")
+        rr = run_policy("round_robin")
+        hit_rate = aff["hits"] / max(aff["hits"] + aff["misses"], 1)
+        rr_hit_rate = rr["hits"] / max(rr["hits"] + rr["misses"], 1)
+        ttft_vs_rr = (
+            aff["mean_ttft"] / rr["mean_ttft"]
+            if rr["mean_ttft"]
+            else 0.0
+        )
+    finally:
+        _lm_cleanup()
+    print(
+        f"LM serving ROUTER ({n_replicas} replicas, {n_families} "
+        f"prompt families x {per_family}): affinity hit rate "
+        f"{hit_rate:.2f} vs RR {rr_hit_rate:.2f}; TTFT "
+        f"affinity/RR {ttft_vs_rr:.3f}; "
+        f"{aff['ok']}/{len(prompts)} ok, retries {aff['retries']}",
+        file=sys.stderr,
+    )
+    return [
+        {
+            "metric": "lm_serve_router_hit_rate",
+            "value": round(hit_rate, 4),
+            "unit": "fraction",
+            "lm_serve_router_config": (
+                f"mid config, {n_replicas} in-process paged replicas "
+                f"(B={b} slots, block {block}) behind the prefix-"
+                f"affinity router; {n_families} families of "
+                f"{LM_PREFIX_SYS}-token shared prefixes x "
+                f"{per_family} requests, interleaved, budget {budget}; "
+                "round-robin twin runs the identical stream on fresh "
+                "replicas"
+            ),
+            "lm_serve_router_ttft_vs_roundrobin": round(ttft_vs_rr, 4),
+            "lm_serve_router_roundrobin_hit_rate": round(
+                rr_hit_rate, 4
+            ),
+            "lm_serve_router_tokens_per_sec": round(
+                aff["tokens"] / aff["wall"], 1
+            ),
+            "lm_serve_router_completed": aff["ok"],
+            "lm_serve_router_retries": aff["retries"],
+            "lm_serve_router_replicas_used": aff["replicas_used"],
+            "lm_serve_router_ttft_ms": round(
+                1000 * aff["mean_ttft"], 1
+            ),
+            "lm_serve_router_roundrobin_ttft_ms": round(
+                1000 * rr["mean_ttft"], 1
+            ),
+            "lm_serve_router_compiles": aff["compiles"],
+        }
+    ]
+
+
 # ---------------------------------------------------------------------------
 
 

@@ -1355,6 +1355,33 @@ class TestUnboundedBlocking:
         )
         assert fs == []
 
+    def test_cluster_scope_fires(self):
+        # ISSUE 8: the serving tier grew znicz_tpu/cluster/ — the
+        # router/registry threads strand CLIENTS when they hang, so
+        # the no-unbounded-waits contract covers them too
+        fs = run(
+            """
+            def pull(q, evt):
+                evt.wait()
+                return q.get()
+            """,
+            "ZNC010",
+            path="znicz_tpu/cluster/router.py",
+        )
+        assert ids(fs) == ["ZNC010"] * 2
+
+    def test_cluster_bounded_calls_are_quiet(self):
+        fs = run(
+            """
+            def sync(evt, thread):
+                evt.wait(timeout=1.0)
+                thread.join(timeout=2.0)
+            """,
+            "ZNC010",
+            path="znicz_tpu/cluster/registry.py",
+        )
+        assert fs == []
+
     def test_pragma_exempts(self):
         fs = run(
             """

@@ -1,12 +1,15 @@
-"""ZNC010: unbounded blocking primitives in ``services/``.
+"""ZNC010: unbounded blocking primitives in the serving tier
+(``services/`` and ``cluster/``).
 
 The serving stack's contract is "no hung clients, ever"
-(docs/SERVING.md): every wait the front door, the HTTP layer, or the
-engine thread performs must be BOUNDED, because a missing timeout turns
-any dropped wake-up, dead peer, or wedged thread into a silent
-permanent hang — the exact failure the watchdog exists to catch.  This
-rule flags the stdlib blocking calls that default to "wait forever"
-when they appear in a ``services/`` module with no ``timeout``:
+(docs/SERVING.md): every wait the front door, the HTTP layer, the
+engine thread, or the cluster router/registry performs must be
+BOUNDED, because a missing timeout turns any dropped wake-up, dead
+peer, or wedged thread into a silent permanent hang — the exact
+failure the watchdog (and the router's heartbeat ladder) exists to
+catch.  This rule flags the stdlib blocking calls that default to
+"wait forever" when they appear in a ``services/`` or ``cluster/``
+module with no ``timeout``:
 
 * ``queue.Queue.get()`` (``.get_nowait()`` / ``.get(timeout=...)`` /
   ``.get(block=False)`` are fine)
@@ -20,8 +23,9 @@ homonyms: a call fires only when it is an ATTRIBUTE call with ZERO
 positional arguments and none of the ``timeout`` / ``block`` /
 ``blocking`` keywords — so ``", ".join(parts)``, ``d.get(key)``,
 ``lock.acquire(False)`` and ``t.join(grace)`` never fire — and only in
-modules under a ``services/`` path (hot training-loop code is free to
-block on purpose; the serving tier is not).  Attribute chains that
+modules under a ``services/`` or ``cluster/`` path (hot training-loop
+code is free to block on purpose; the serving tier is not).  Attribute
+chains that
 resolve to an imported MODULE (``os.wait()``) are skipped: the rule
 targets object-level synchronization primitives.
 
@@ -45,13 +49,17 @@ class UnboundedBlockingRule(Rule):
     id = "ZNC010"
     severity = "warning"
     title = (
-        "unbounded blocking call in services/ (pass a timeout: a "
-        "missing one turns a lost wake-up into a permanent hang)"
+        "unbounded blocking call in the serving tier (pass a timeout: "
+        "a missing one turns a lost wake-up into a permanent hang)"
     )
 
+    # the serving tier: every package whose threads a hung wait strands
+    # a CLIENT in, not just a batch job
+    _SCOPES = ("/services/", "/cluster/")
+
     def _in_services(self, info) -> bool:
-        path = info.path.replace("\\", "/")
-        return "/services/" in f"/{path}"
+        path = f"/{info.path}".replace("\\", "/")
+        return any(scope in path for scope in self._SCOPES)
 
     def check(self, info) -> Iterable:
         if not self._in_services(info):

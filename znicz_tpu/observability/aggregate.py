@@ -217,6 +217,25 @@ def _canon_prom_text(text: str) -> dict:
     return out
 
 
+def series_value(
+    families: Optional[dict],
+    name: str,
+    labels: Optional[Dict[str, str]] = None,
+) -> Optional[float]:
+    """Counter/gauge series value out of a canonicalized families dict
+    (as returned by :meth:`MetricsAggregator.instance_families`); None
+    when the family/series is absent or is a histogram."""
+    if families is None:
+        return None
+    fam = families.get(name)
+    if fam is None or fam.get("type") == "histogram":
+        return None
+    ser = fam["series"].get(_label_key(dict(labels or {})))
+    if ser is None:
+        return None
+    return float(ser["value"])
+
+
 def _cumulative_pairs(buckets: Dict[str, float]) -> List[Tuple[float, float]]:
     return sorted(
         ((float(le), float(c)) for le, c in buckets.items()),
@@ -321,6 +340,33 @@ class MetricsAggregator:
             }
             for name, inst in sorted(self._live(now).items())
         ]
+
+    def instance_families(
+        self, instance: str, now: Optional[float] = None
+    ) -> Optional[dict]:
+        """ONE live instance's canonicalized families dict (None when
+        unknown/stale).  Treat as read-only; extract series with
+        :func:`series_value`.  One staleness sweep + lock acquisition
+        buys every per-instance read a caller needs — the cluster
+        router reads five load series per replica per routing
+        decision through this."""
+        inst = self._live(now).get(str(instance))
+        return None if inst is None else inst.families
+
+    def instance_value(
+        self,
+        instance: str,
+        name: str,
+        labels: Optional[Dict[str, str]] = None,
+        now: Optional[float] = None,
+    ) -> Optional[float]:
+        """ONE instance's latest counter/gauge series value, or None
+        when the instance is unknown/stale, the family absent, or the
+        series is a histogram.  The merged view sums across instances,
+        which is exactly the wrong shape for picking between them."""
+        return series_value(
+            self.instance_families(instance, now), name, labels
+        )
 
     def merged_snapshot(self, now: Optional[float] = None) -> dict:
         """The fleet view in registry-``snapshot()`` shape: counters and

@@ -93,6 +93,36 @@ _COMPILED_KEYS: set = set()
 _PREFIX_SEED = b"znicz-prefix-v1"
 
 
+def _chain_digests(tokens: np.ndarray, block_size: int):
+    """Chained sha256 over full ``block_size``-token blocks of
+    ``tokens``: block j's key commits to ALL tokens before it, so equal
+    keys mean equal K/V content, and walking the chain until the first
+    miss is the longest-cached-prefix descent of an implicit radix
+    structure.  The ONE owner of the keying scheme — the engine's
+    prefix cache and the cluster router's affinity index both hash
+    through here, so their keys can never drift apart."""
+    h = _PREFIX_SEED
+    for j in range(tokens.size // block_size):
+        h = hashlib.sha256(
+            h
+            + np.ascontiguousarray(
+                tokens[j * block_size:(j + 1) * block_size]
+            ).tobytes()
+        ).digest()
+        yield h
+
+
+def prefix_block_keys(prompt, block_size: int) -> List[str]:
+    """Public prefix-cache block keys for ``prompt`` (hex, full blocks
+    only) — the routing key a :class:`~znicz_tpu.cluster.router
+    .ServingRouter` indexes replicas by, and what
+    :meth:`DecodeEngine.prefix_probe` returns.  Pure function of the
+    token content (prompts are hashed as int32, matching the engine's
+    internal chain), independent of any live engine state."""
+    p = np.asarray(prompt, np.int32).reshape(-1)
+    return [h.hex() for h in _chain_digests(p, int(block_size))]
+
+
 @dataclasses.dataclass
 class RequestTimings:
     """Per-request lifecycle breakdown — the answer to "why was this
@@ -852,6 +882,22 @@ class DecodeEngine:
 
     # -- introspection ----------------------------------------------------
 
+    def prefix_probe(self, prompt) -> Dict:
+        """Public prefix-cache probe: the prompt's chained block keys
+        (:func:`prefix_block_keys`) and how many of its lead blocks are
+        already cached HERE.  The dense backend has no shareable blocks,
+        so its answer is the empty probe — the router (and tests) read
+        this hook instead of engine privates; the paged subclass
+        overrides it with the real cache walk."""
+        np.asarray(prompt, np.int32).reshape(-1)  # same coercion contract
+        return {
+            "prefix_cache": False,
+            "block_size": None,
+            "block_keys": [],
+            "cached_blocks": 0,
+            "cached_tokens": 0,
+        }
+
     def compile_stats(self) -> Dict:
         """Compile-count hook: ``programs`` maps each
         ``("admit", bucket, structure)`` / ``("chunk", chunk, B,
@@ -1262,21 +1308,36 @@ class PagedDecodeEngine(DecodeEngine):
     # -- the prefix cache -------------------------------------------------
 
     def _chain_hashes(self, tokens: np.ndarray):
-        """Chained sha256 over full ``block_size``-token blocks of
-        ``tokens``: block j's key commits to ALL tokens before it, so
-        equal keys mean equal K/V content, and walking the chain until
-        the first miss is the longest-cached-prefix descent of an
-        implicit radix structure."""
-        h = _PREFIX_SEED
-        bs = self.block_size
-        for j in range(tokens.size // bs):
-            h = hashlib.sha256(
-                h
-                + np.ascontiguousarray(
-                    tokens[j * bs:(j + 1) * bs]
-                ).tobytes()
-            ).digest()
-            yield h
+        """This pool's view of the shared keying scheme (see
+        :func:`_chain_digests`): raw digests at this engine's block
+        size."""
+        yield from _chain_digests(tokens, self.block_size)
+
+    def prefix_probe(self, prompt) -> Dict:
+        """Paged probe: the prompt's chained block keys plus how many
+        lead blocks are CURRENTLY resident in this engine's prefix
+        cache (``cached_blocks`` is the longest cached chain prefix —
+        exactly what admission would map).  Advisory: the cache mutates
+        every tick, so the count is a snapshot, not a reservation.
+        Safe to call from any thread (dict lookups only, no
+        iteration)."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        keys: List[str] = []
+        cached = 0
+        walking = self.prefix_cache
+        for h in _chain_digests(p, self.block_size):
+            keys.append(h.hex())
+            if walking and h in self._cache:
+                cached += 1
+            else:
+                walking = False
+        return {
+            "prefix_cache": self.prefix_cache,
+            "block_size": self.block_size,
+            "block_keys": keys,
+            "cached_blocks": cached,
+            "cached_tokens": cached * self.block_size,
+        }
 
     def _lookup_prefix(self, req: Request) -> List[int]:
         """Longest cached block-chain prefix of the request's prompt

@@ -14,6 +14,7 @@ unchanged.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 
@@ -53,3 +54,12 @@ def retryable(exc: BaseException) -> Optional[float]:
     if isinstance(exc, EngineClosedError):
         return 1.0
     return None
+
+
+def retry_after_header(exc: BaseException) -> str:
+    """``Retry-After`` header value for a retryable error: whole
+    seconds, rounded up, floored at 1.  ONE owner of the clamping
+    rule, shared by the replica HTTP surface and the cluster router's
+    — the two must never advertise different backoff for the same
+    rejection."""
+    return str(max(int(math.ceil(retryable(exc) or 1.0)), 1))

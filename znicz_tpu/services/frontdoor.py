@@ -540,7 +540,26 @@ class ServingFrontDoor:
             "restarts": self._n_restarts,
             "pending": len(self._pending),
             "inflight": len(self._inflight),
+            # the per-replica load signal a cluster router tiebreaks on
+            # (rides /healthz, so one heartbeat carries liveness AND
+            # load; 1.0 on the dense backend — no pool to run dry)
+            "pool_free_frac": round(self._pool_free_frac, 4),
         }
+
+    def prefix_probe(self, prompt) -> Dict:
+        """Delegate to the CURRENT engine's public
+        :meth:`~znicz_tpu.services.engine.DecodeEngine.prefix_probe`:
+        the prompt's chained block keys plus the cached-block count —
+        what a prefix-affinity router (or a test) reads instead of
+        engine privates.  Advisory snapshot (the engine thread mutates
+        the cache between ticks); raises :class:`EngineClosedError`
+        when the engine is down."""
+        eng = self._engine
+        if eng is None:
+            raise EngineClosedError(
+                "engine is down; nothing to probe"
+            )
+        return eng.prefix_probe(prompt)
 
     def healthy(self) -> bool:
         return self.watchdog_state()["state"] == "running"

@@ -45,7 +45,6 @@ import functools
 import http.server
 import json
 import logging
-import math
 import os
 import signal
 import sys
@@ -56,7 +55,7 @@ from znicz_tpu.services.errors import (
     EngineClosedError,
     RejectedError,
     RequestTooLargeError,
-    retryable,
+    retry_after_header,
 )
 
 logger = logging.getLogger(__name__)
@@ -99,12 +98,50 @@ def _snapshot_from_prom(text: str) -> dict:
     return out
 
 
-class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
+class HttpJsonMixin:
+    """Shared response writers for the repo's HTTP/1.1 surfaces (this
+    status/front-door server and the cluster router proxy): explicit
+    Content-Length on every non-streaming response, and the chunked
+    NDJSON frame writer for token streams.  ONE owner, so the framing
+    can never diverge between a replica and the router fronting it."""
+
+    def _chunk(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _send_json(self, obj: dict, status: int = 200, headers=None):
+        self._send(
+            (json.dumps(obj) + "\n").encode(),
+            "application/json",
+            status=status,
+            headers=headers,
+        )
+
+    def _send(
+        self,
+        body: bytes,
+        content_type: str,
+        status: int = 200,
+        headers=None,
+    ) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class StatusRequestHandler(
+    HttpJsonMixin, http.server.SimpleHTTPRequestHandler
+):
     """Static status files + registry export + the serving front door.
 
     HTTP/1.1 so ``POST /generate`` can stream chunked responses; every
     non-streaming response therefore carries an explicit
-    Content-Length (``_send``)."""
+    Content-Length (:class:`HttpJsonMixin`)."""
 
     protocol_version = "HTTP/1.1"
 
@@ -204,6 +241,9 @@ class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 — http.server API
         path = self.path.split("?", 1)[0]
+        if path == "/prefix_probe":
+            self._do_prefix_probe()
+            return
         if path != "/generate":
             self.send_error(404, "unknown endpoint")
             return
@@ -237,22 +277,14 @@ class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
                 {"error": "rejected", "reason": exc.reason,
                  "detail": str(exc)},
                 status=503,
-                headers={
-                    "Retry-After": str(
-                        max(int(math.ceil(retryable(exc) or 1.0)), 1)
-                    )
-                },
+                headers={"Retry-After": retry_after_header(exc)},
             )
             return
         except EngineClosedError as exc:
             self._send_json(
                 {"error": "engine_closed", "detail": str(exc)},
                 status=503,
-                headers={
-                    "Retry-After": str(
-                        max(int(math.ceil(retryable(exc) or 1.0)), 1)
-                    )
-                },
+                headers={"Retry-After": retry_after_header(exc)},
             )
             return
         except RequestTooLargeError as exc:
@@ -270,6 +302,38 @@ class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
             )
             return
         self._stream_generation(fd, handle)
+
+    def _do_prefix_probe(self) -> None:
+        """``POST /prefix_probe`` ``{"prompt": [ids]}`` — the front
+        door's public prefix-cache probe over HTTP: the prompt's
+        chained block keys plus this replica's cached-block count.  A
+        debugging surface for prefix-affinity routing (compare the
+        router's learned index against the replica's actual cache) —
+        the router itself never calls it; its index tracks, never
+        trusts, replica state."""
+        fd = self.frontdoor
+        if fd is None:
+            self._send_json(
+                {"error": "no_engine",
+                 "detail": "this server has no serving front door attached"},
+                status=503,
+            )
+            return
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            probe = fd.prefix_probe(body["prompt"])
+        except EngineClosedError as exc:
+            self._send_json(
+                {"error": "engine_closed", "detail": str(exc)}, status=503
+            )
+            return
+        except (KeyError, TypeError, ValueError) as exc:
+            self._send_json(
+                {"error": "bad_request", "detail": str(exc)}, status=400
+            )
+            return
+        self._send_json(probe)
 
     def _stream_generation(self, fd, handle) -> None:
         """Chunked NDJSON token stream; a broken pipe mid-stream
@@ -311,35 +375,6 @@ class StatusRequestHandler(http.server.SimpleHTTPRequestHandler):
                 "client gone mid-stream; cancelling %s", handle.id
             )
             fd.cancel(handle.id)
-
-    def _chunk(self, obj: dict) -> None:
-        data = (json.dumps(obj) + "\n").encode()
-        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-        self.wfile.flush()
-
-    def _send_json(self, obj: dict, status: int = 200, headers=None):
-        self._send(
-            (json.dumps(obj) + "\n").encode(),
-            "application/json",
-            status=status,
-            headers=headers,
-        )
-
-    def _send(
-        self,
-        body: bytes,
-        content_type: str,
-        status: int = 200,
-        headers=None,
-    ) -> None:
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        for key, value in (headers or {}).items():
-            self.send_header(key, value)
-        self.end_headers()
-        self.wfile.write(body)
-
 
 def build_server(
     directory: str = ".",

@@ -24,6 +24,18 @@ point                     effect when armed
 ``pusher.push``           raises/sleeps inside a MetricsPusher push (a
                           dead or slow aggregator: the push failure
                           path — counted, logged, never propagated)
+``router.connect``        raises as the cluster router opens a replica
+                          connection (replica connect refused: the
+                          route-to-next-best failover path)
+``router.stream``         raises as the router reads one record of a
+                          replica's token stream (mid-stream replica
+                          death: the skip-prefix re-route path; arm
+                          with ``after=k`` to let k records through
+                          first)
+``router.heartbeat``      raises inside a registry heartbeat probe
+                          (heartbeat timeout: the ejection /
+                          re-admission ladder without killing a real
+                          server)
 ========================  ==================================================
 
 Arming::
@@ -34,16 +46,19 @@ Arming::
 
     faults.inject("pool.pressure", flag=True)   # until faults.clear()
     faults.inject("frontdoor.slow_tick", delay=0.05)
+    faults.inject("router.stream", after=2, times=1)  # 3rd read dies
 
 or from the environment (process-wide, e.g. a chaos soak)::
 
     ZNICZ_FAULTS="engine.decode_step:times=1,frontdoor.slow_tick:delay=0.2"
 
 Each spec is ``point[:field]...`` with fields ``times=<int>`` (default
-unlimited), ``delay=<seconds>`` and ``flag`` (behavioral: fire just
-returns True); a point with none of them raises :class:`FaultInjected`
-when fired.  The hot-path cost of an UNARMED registry is one
-truthiness check on an empty dict.
+unlimited), ``after=<int>`` (the first ``after`` fires pass through
+untouched — how "die mid-stream, not at the start" is made
+deterministic), ``delay=<seconds>`` and ``flag`` (behavioral: fire
+just returns True); a point with none of ``exc``/``delay``/``flag``
+raises :class:`FaultInjected` when fired.  The hot-path cost of an
+UNARMED registry is one truthiness check on an empty dict.
 """
 
 from __future__ import annotations
@@ -69,14 +84,15 @@ class FaultInjected(RuntimeError):
 
 
 class _Fault:
-    __slots__ = ("name", "exc", "delay", "remaining")
+    __slots__ = ("name", "exc", "delay", "remaining", "after")
 
     def __init__(self, name: str, exc: Optional[BaseException],
-                 delay: float, times: Optional[int]):
+                 delay: float, times: Optional[int], after: int):
         self.name = name
         self.exc = exc
         self.delay = float(delay)
         self.remaining = times  # None = until cleared
+        self.after = int(after)  # fires to let through before acting
 
 
 # module-level registry: empty in production, so fire() is one dict
@@ -92,16 +108,19 @@ def inject(
     delay: float = 0.0,
     times: Optional[int] = None,
     flag: bool = False,
+    after: int = 0,
 ) -> None:
     """Arm ``name``.  ``exc`` raises at the point; ``delay`` sleeps
     there; ``flag`` arms a BEHAVIORAL point (``fire`` just returns
     True — e.g. ``pool.pressure`` reports the pool dry).  With none of
     the three, firing raises :class:`FaultInjected`.  ``times`` bounds
-    how many fires before auto-disarm (None = until :func:`clear`)."""
+    how many fires before auto-disarm (None = until :func:`clear`);
+    ``after`` lets the first N fires pass through untouched first —
+    "the third stream read dies", not the first."""
     if exc is None and delay == 0.0 and not flag:
         exc = FaultInjected(f"injected fault at {name!r}")
     with _LOCK:
-        _ARMED[name] = _Fault(name, exc, delay, times)
+        _ARMED[name] = _Fault(name, exc, delay, times, after)
 
 
 def clear(name: Optional[str] = None) -> None:
@@ -131,6 +150,9 @@ def fire(name: str) -> bool:
         fault = _ARMED.get(name)
         if fault is None:
             return False
+        if fault.after > 0:
+            fault.after -= 1
+            return False  # pass-through fire: not yet our turn
         if fault.remaining is not None:
             fault.remaining -= 1
             if fault.remaining <= 0:
@@ -150,10 +172,11 @@ def injected(
     delay: float = 0.0,
     times: Optional[int] = None,
     flag: bool = False,
+    after: int = 0,
 ) -> Iterator[None]:
     """Scoped :func:`inject` — the point is disarmed on exit even if
     the body (or the fault itself) raised."""
-    inject(name, exc=exc, delay=delay, times=times, flag=flag)
+    inject(name, exc=exc, delay=delay, times=times, flag=flag, after=after)
     try:
         yield
     finally:
@@ -174,6 +197,8 @@ def _parse_env(spec: str) -> None:
             key, _, value = field.partition("=")
             if key == "times":
                 kwargs["times"] = int(value)
+            elif key == "after":
+                kwargs["after"] = int(value)
             elif key == "delay":
                 kwargs["delay"] = float(value)
             elif key == "flag" and not value:
@@ -181,7 +206,8 @@ def _parse_env(spec: str) -> None:
             else:
                 raise ValueError(
                     f"ZNICZ_FAULTS: unknown field {key!r} in {part!r} "
-                    "(want times=<int>, delay=<seconds>, or flag)"
+                    "(want times=<int>, after=<int>, delay=<seconds>, "
+                    "or flag)"
                 )
         inject(fields[0], **kwargs)
 
