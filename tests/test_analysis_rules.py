@@ -1491,6 +1491,543 @@ class TestDynamicMetricNames:
         assert fs == []
 
 
+# -- ZNC012: lock discipline ----------------------------------------------
+
+
+class TestLockDiscipline:
+    RACY = """
+        import threading
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True
+                )
+
+            def submit(self, item):
+                with self._lock:
+                    self._pending.append(item)
+
+            def _loop(self):
+                while True:
+                    expired = [x for x in list(self._pending) if x]
+        """
+
+    def test_bare_iterate_of_locked_container_fires(self):
+        fs = run(self.RACY, "ZNC012", path=SERVICES_PATH)
+        assert ids(fs) == ["ZNC012"]
+        assert "_pending" in fs[0].message
+        assert "thread:_loop" in fs[0].message
+
+    def test_lock_correct_equivalent_is_quiet(self):
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pending = []
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def submit(self, item):
+                    with self._lock:
+                        self._pending.append(item)
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            expired = list(self._pending)
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_bare_write_to_lock_read_flag_fires(self):
+        # the shipped shape: a flag READ under the lock on the client
+        # path, STORED bare from two different threads
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._closed = False
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def submit(self):
+                    with self._lock:
+                        if self._closed:
+                            raise RuntimeError("closed")
+
+                def close(self):
+                    self._closed = True
+
+                def _loop(self):
+                    self._closed = True
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC012", "ZNC012"]
+
+    def test_plain_read_of_atomic_is_quiet(self):
+        # reading a lock-guarded counter without the lock is stale,
+        # not torn — the negative case the issue pins
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    with self._lock:
+                        self._n += 1
+
+                def stats(self):
+                    return {"n": self._n, "big": self._n > 10}
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_init_writes_are_quiet(self):
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+                    self._items.append("seed")
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_single_thread_root_is_quiet(self):
+        # an attribute only the dedicated thread ever touches cannot
+        # race, locked sometimes or not
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._scratch = []
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    self._a()
+                    self._b()
+
+                def _a(self):
+                    with self._lock:
+                        self._scratch.append(1)
+
+                def _b(self):
+                    self._scratch.append(2)
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_lock_held_by_caller_convention_is_quiet(self):
+        # a private method whose every call site holds the lock runs
+        # under it (the repo's documented "lock held by the caller")
+        fs = run(
+            """
+            import threading
+
+            class Roster:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._replicas = {}
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def register(self, name):
+                    with self._lock:
+                        self._replicas[name] = 1
+                        self._update_gauges()
+
+                def _loop(self):
+                    with self._lock:
+                        self._update_gauges()
+
+                def _update_gauges(self):
+                    for name in list(self._replicas):
+                        pass
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_immutable_config_iteration_is_quiet(self):
+        # a tuple assigned only in __init__ is config, not shared
+        # mutable state — iterating it bare cannot race
+        fs = run(
+            """
+            import threading
+
+            class Mon:
+                def __init__(self, windows):
+                    self._lock = threading.Lock()
+                    self.windows = tuple(windows)
+                    self._ring = []
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    with self._lock:
+                        self._ring.append(1)
+
+                def snapshot(self):
+                    return [w for w in self.windows]
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_class_without_lock_is_quiet(self):
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def __init__(self):
+                    self._items = []
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def add(self, x):
+                    self._items.append(x)
+
+                def _loop(self):
+                    self._items.clear()
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_outside_serving_tier_is_quiet(self):
+        fs = run(self.RACY, "ZNC012", path="znicz_tpu/loader/x.py")
+        assert fs == []
+
+    def test_observability_scope_fires(self):
+        fs = run(
+            self.RACY, "ZNC012", path="znicz_tpu/observability/x.py"
+        )
+        assert ids(fs) == ["ZNC012"]
+
+    def test_pragma_exempts(self):
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._closed = False
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def submit(self):
+                    with self._lock:
+                        return self._closed
+
+                def _loop(self):
+                    # atomic bool store; stale reads are acceptable
+                    self._closed = True  # znicz-check: disable=ZNC012
+            """,
+            "ZNC012",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+
+# -- ZNC013: thread exception sink -----------------------------------------
+
+
+class TestThreadExceptionSink:
+    def test_unguarded_method_target_fires(self):
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+                    self._thread.start()
+
+                def _loop(self):
+                    while True:
+                        self._sweep()
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC013"]
+        assert "_loop" in fs[0].message
+
+    def test_log_wrapped_loop_is_quiet(self):
+        fs = run(
+            """
+            import logging
+            import threading
+
+            logger = logging.getLogger(__name__)
+
+            class Door:
+                def start(self):
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True
+                    )
+
+                def _loop(self):
+                    while not self._stop.wait(timeout=2.0):
+                        try:
+                            self._sweep()
+                        except Exception:
+                            logger.warning("sweep failed", exc_info=True)
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_narrow_handler_still_fires(self):
+        fs = run(
+            """
+            import logging
+            import threading
+
+            logger = logging.getLogger(__name__)
+
+            class Door:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    try:
+                        self._sweep()
+                    except OSError:
+                        logger.warning("io failed")
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC013"]
+
+    def test_silent_broad_handler_still_fires(self):
+        # `except Exception: pass` protects nothing (and ZNC008 flags
+        # the swallow separately)
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    try:
+                        self._sweep()
+                    except Exception:
+                        pass
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC013"]
+
+    def test_typed_event_handler_is_the_sink(self):
+        # the front door's shape: the broad handler delegates to the
+        # typed-failure path; the rule does not demand infinite regress
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    while True:
+                        try:
+                            self._tick()
+                        except Exception as exc:
+                            self._engine_failure(exc)
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_module_level_target_fires(self):
+        fs = run(
+            """
+            import threading
+
+            def worker(q):
+                while True:
+                    handle(q.get(timeout=1.0))
+
+            def start(q):
+                threading.Thread(target=worker, args=(q,)).start()
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC013"]
+        assert "worker" in fs[0].message
+
+    def test_lambda_target_fires(self):
+        fs = run(
+            """
+            import threading
+
+            def start(server):
+                threading.Thread(target=lambda: server.run()).start()
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC013"]
+
+    def test_unresolvable_target_is_skipped(self):
+        fs = run(
+            """
+            import threading
+
+            def start(server):
+                threading.Thread(target=server.shutdown).start()
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_outside_serving_tier_is_quiet(self):
+        fs = run(
+            """
+            import threading
+
+            def worker():
+                risky()
+
+            threading.Thread(target=worker).start()
+            """,
+            "ZNC013",
+            path="znicz_tpu/loader/prefetch.py",
+        )
+        assert fs == []
+
+    def test_reraising_handler_is_not_a_sink(self):
+        """``raise RuntimeError(exc)`` still kills the thread — the
+        exception-constructor call must not count as handling."""
+        fs = run(
+            """
+            import threading
+
+            class Door:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    try:
+                        self._work()
+                    except Exception as exc:
+                        raise RuntimeError(exc)
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert ids(fs) == ["ZNC013"]
+
+    def test_logging_then_reraising_handler_is_a_sink(self):
+        # the death is at least a LOGGED event; the log call (outside
+        # the raise) qualifies
+        fs = run(
+            """
+            import logging
+            import threading
+
+            logger = logging.getLogger(__name__)
+
+            class Door:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    try:
+                        self._work()
+                    except Exception as exc:
+                        logger.exception("worker died")
+                        raise
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+    def test_pragma_exempts(self):
+        fs = run(
+            """
+            import threading
+
+            class Pusher:
+                def start(self):
+                    # push_now never raises (catches all internally)
+                    t = threading.Thread(  # znicz-check: disable=ZNC013
+                        target=self._loop,
+                    )
+                    t.start()
+
+                def _loop(self):
+                    while not self._stop.wait(timeout=1.0):
+                        self.push_now()
+            """,
+            "ZNC013",
+            path=SERVICES_PATH,
+        )
+        assert fs == []
+
+
 # -- pragmas -------------------------------------------------------------
 
 

@@ -3,34 +3,56 @@
 Any NEW analyzer finding (relative to tools/znicz_check_baseline.json)
 fails this test, which makes JAX-hygiene regressions — tracer-leaking
 branches, host effects in jitted bodies, misspelled mesh axes, PRNG
-reuse, swallowed exceptions — a test failure instead of a silent TPU
-incident.  The workflow for a legitimate exception is an inline
+reuse, swallowed exceptions, serving-tier lock-discipline races —
+a test failure instead of a silent TPU (or paging) incident.
+
+The gate runs the PROJECT-WIDE analysis (one index over the whole
+package: cross-module transform applications and call-chain helper
+marking included), asserts the index itself builds clean, and caps the
+analyzer's runtime so the gate stays cheap enough to run on every
+commit.  The workflow for a legitimate exception is an inline
 ``# znicz-check: disable=RULE`` pragma with a reason, or (for
 pre-existing debt only) regenerating the baseline; see
 docs/STATIC_ANALYSIS.md.
 """
 
+import json
 import os
+import textwrap
+import time
 
 import znicz_tpu
 from znicz_tpu.analysis import (
-    analyze_paths,
+    RULES,
+    analyze_project,
     load_baseline,
     new_findings,
 )
 from znicz_tpu.analysis.engine import stale_baseline_entries
+from znicz_tpu.analysis.project import ProjectIndex
 
 PKG_DIR = os.path.dirname(os.path.abspath(znicz_tpu.__file__))
 REPO_ROOT = os.path.dirname(PKG_DIR)
 BASELINE = os.path.join(REPO_ROOT, "tools", "znicz_check_baseline.json")
 
+# one shared project run per test session: the gate asserts several
+# properties of the SAME analysis, and the runtime cap below is the
+# budget for exactly one build
+_CACHE = {}
 
-def _current_findings():
-    return analyze_paths([PKG_DIR], root=REPO_ROOT)
+
+def _project():
+    if "result" not in _CACHE:
+        t0 = time.monotonic()
+        findings, index = analyze_project([PKG_DIR], root=REPO_ROOT)
+        _CACHE["result"] = (
+            findings, index, time.monotonic() - t0
+        )
+    return _CACHE["result"]
 
 
 def test_package_has_no_new_findings():
-    findings = _current_findings()
+    findings, _, _ = _project()
     baseline = load_baseline(BASELINE)
     new = new_findings(findings, baseline)
     assert not new, (
@@ -44,7 +66,7 @@ def test_baseline_is_not_stale():
     """Burned-down debt must leave the ledger: a baseline entry that no
     longer fires means someone fixed it — shrink the file so it can't
     mask a future regression at the same fingerprint."""
-    findings = _current_findings()
+    findings, _, _ = _project()
     baseline = load_baseline(BASELINE)
     stale = stale_baseline_entries(findings, baseline)
     assert not stale, (
@@ -61,3 +83,570 @@ def test_committed_baseline_stays_small():
         "the suppression baseline is growing — burn findings down or "
         "pragma-exempt them with reasons instead of baselining"
     )
+
+
+def test_project_index_builds_clean_and_fast():
+    """The whole-package index must parse every module (ZNC000-free),
+    resolve a plausible symbol table, and finish inside the CI
+    budget — a quadratic blowup in the call-graph pass would otherwise
+    quietly turn every tier-1 run into minutes of analyzer time."""
+    _, index, wall_s = _project()
+    assert not index.syntax_findings, [
+        f.format() for f in index.syntax_findings
+    ]
+    assert len(index.modules) >= 100  # the package, not a subset
+    assert index.defs  # symbol table populated
+    assert wall_s < 60.0, f"analyzer took {wall_s:.1f}s (budget 60s)"
+
+
+def test_project_pass_sees_known_cross_module_facts():
+    """Pin two facts the project pass discovered about THIS repo, so a
+    refactor that silently breaks resolution fails loudly: the
+    transformer workflow shard_maps the pallas flash-attention body
+    across modules, and the serving engine's jit of the generate
+    helpers chain-marks them."""
+    _, index, _ = _project()
+    targets = {a["target"] for a in index.applications}
+    assert any("flash_attention" in t for t in targets), targets
+    helpers = {c["helper"] for c in index.chains()}
+    assert any("generate" in h for h in helpers), helpers
+
+
+def test_thread_safety_rules_are_registered():
+    assert "ZNC012" in RULES and "ZNC013" in RULES
+    assert RULES["ZNC012"].severity in ("error", "warning")
+    assert RULES["ZNC013"].severity in ("error", "warning")
+
+
+# -- cross-module traced-context detection (the acceptance fixture) -------
+
+
+def _write(tmp_path, name, src):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+
+
+def _run_project(tmp_path, select=("ZNC001", "ZNC002")):
+    rules = [RULES[r]() for r in select]
+    return analyze_project(
+        [str(tmp_path)], root=str(tmp_path), rules=rules
+    )
+
+
+class TestCrossModuleTransforms:
+    STEP = """
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        """
+
+    def test_jit_in_other_module_marks_the_def(self, tmp_path):
+        """Module A defines ``step`` with a traced-branch hazard;
+        module B applies ``jax.jit(step)`` — ZNC001 must fire (and
+        must NOT without the application): the acceptance pin."""
+        _write(tmp_path, "liba.py", self.STEP)
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            import liba
+
+            fast = jax.jit(liba.step)
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert [f.rule for f in findings] == ["ZNC001"]
+        assert findings[0].path == "liba.py"
+        assert findings[0].symbol == "step"
+
+    def test_no_application_no_finding(self, tmp_path):
+        _write(tmp_path, "liba.py", self.STEP)
+        _write(tmp_path, "libb.py", "import liba\n")
+        findings, _ = _run_project(tmp_path)
+        assert findings == []
+
+    def test_from_import_spelling_resolves(self, tmp_path):
+        _write(tmp_path, "liba.py", self.STEP)
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            from liba import step
+
+            fast = jax.jit(step)
+            """,
+        )
+        findings, index = _run_project(tmp_path)
+        assert [f.rule for f in findings] == ["ZNC001"]
+        assert index.applications and (
+            index.applications[0]["target"] == "liba.step"
+        )
+
+    def test_cross_module_static_argnames_honored(self, tmp_path):
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            def step(x, greedy):
+                if greedy:
+                    return x
+                return -x
+            """,
+        )
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            import liba
+
+            fast = jax.jit(liba.step, static_argnames=("greedy",))
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert findings == []
+
+    def test_cross_module_lax_scan_body(self, tmp_path):
+        _write(
+            tmp_path,
+            "bodies.py",
+            """
+            import time
+
+            def body(c, x):
+                t = time.time()
+                return c + x, t
+            """,
+        )
+        _write(
+            tmp_path,
+            "driver.py",
+            """
+            import jax
+            import bodies
+
+            def run(xs):
+                return jax.lax.scan(bodies.body, 0.0, xs)
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert [f.rule for f in findings] == ["ZNC002"]
+        assert findings[0].path == "bodies.py"
+
+    def test_package_dotted_modules_resolve(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        _write(tmp_path, "pkg/ops.py", self.STEP)
+        _write(
+            tmp_path,
+            "main.py",
+            """
+            import jax
+            from pkg import ops
+
+            fast = jax.jit(ops.step)
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert [f.rule for f in findings] == ["ZNC001"]
+        assert findings[0].path == "pkg/ops.py"
+
+
+class TestChainReportedHelpers:
+    def test_traced_only_helper_reported_at_entry_with_chain(
+        self, tmp_path
+    ):
+        """A helper whose only call sites sit in traced code is
+        analyzed as traced; the finding lands at the traced ENTRY with
+        the chain in the message (that's where the fix applies)."""
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            def helper(y):
+                if y > 0:
+                    return y
+                return -y
+            """,
+        )
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            import liba
+
+            @jax.jit
+            def outer(x):
+                return liba.helper(x)
+            """,
+        )
+        findings, index = _run_project(tmp_path)
+        assert [f.rule for f in findings] == ["ZNC001"]
+        f = findings[0]
+        assert f.path == "libb.py" and f.symbol == "outer"
+        assert "liba.helper" in f.message
+        assert "libb.outer -> liba.helper" in f.message
+        assert index.chains()[0]["helper"] == "liba.helper"
+
+    def test_helper_also_called_from_host_stays_quiet(self, tmp_path):
+        """One host call site proves a concrete-Python contract: the
+        helper must not be marked (the conservative side of the
+        approximation)."""
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            def helper(y):
+                if y > 0:
+                    return y
+                return -y
+            """,
+        )
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            import liba
+
+            @jax.jit
+            def outer(x):
+                return liba.helper(x)
+
+            def host(z):
+                return liba.helper(z)
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert findings == []
+
+    def test_call_site_literals_stay_static(self, tmp_path):
+        """Parameters a traced call site binds to literals are static
+        — ``helper(x, training=False)`` must not flag
+        ``if training:``."""
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            def helper(y, training):
+                if training:
+                    return y * 2
+                return y
+            """,
+        )
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            import liba
+
+            @jax.jit
+            def outer(x):
+                return liba.helper(x, training=False)
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert findings == []
+
+    def test_shadowing_parameter_is_not_the_module_def(self, tmp_path):
+        """``outer(x, helper)`` calling its PARAMETER must not be
+        attributed to an unrelated module-level def of the same name
+        and chain-marked off it (review regression)."""
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            import time
+            import jax
+
+            def helper(y):
+                return time.time() + y
+
+            @jax.jit
+            def outer(x, helper):
+                return helper(x)
+            """,
+        )
+        findings, index = _run_project(tmp_path)
+        assert findings == []
+        assert index.chains() == []
+
+    def test_shadowed_transform_target_is_not_resolved(self, tmp_path):
+        """``jax.jit(step)`` where ``step`` is the enclosing function's
+        parameter must not mark the module-level ``step``."""
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            import jax
+
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+
+            def compile_it(step):
+                return jax.jit(step)
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert findings == []
+
+    def test_pragma_on_the_helper_line_suppresses(self, tmp_path):
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            def helper(y):
+                if y > 0:  # znicz-check: disable=ZNC001
+                    return y
+                return -y
+            """,
+        )
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            import liba
+
+            @jax.jit
+            def outer(x):
+                return liba.helper(x)
+            """,
+        )
+        findings, _ = _run_project(tmp_path)
+        assert findings == []
+
+
+# -- CLI surfaces ---------------------------------------------------------
+
+
+class TestCliSurfaces:
+    def _main(self, argv):
+        from znicz_tpu.analysis.__main__ import main
+
+        return main(argv)
+
+    def test_sarif_output_shape(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        return 1\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        rc = self._main(
+            [
+                str(bad),
+                "--root",
+                str(tmp_path),
+                "--no-baseline",
+                "--format",
+                "sarif",
+            ]
+        )
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "znicz-check"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert rule_ids == {"ZNC008"}
+        result = run["results"][0]
+        assert result["ruleId"] == "ZNC008"
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "bad.py"
+        assert loc["region"]["startLine"] == 4
+        assert "zniczCheck/v1" in result["partialFingerprints"]
+        # SRCROOT must resolve to the analysis root so base-honoring
+        # viewers (VS Code SARIF, sarif-multitool) open the real file
+        base = run["originalUriBaseIds"]["SRCROOT"]["uri"]
+        assert base.startswith("file://") and base.endswith("/")
+        assert str(tmp_path) in base
+
+    def test_sarif_clean_run_is_exit_zero(self, tmp_path, capsys):
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x\n")
+        rc = self._main(
+            [
+                str(good),
+                "--root",
+                str(tmp_path),
+                "--no-baseline",
+                "--format",
+                "sarif",
+            ]
+        )
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["runs"][0]["results"] == []
+
+    def test_changed_rejects_bogus_ref(self):
+        import pytest
+
+        with pytest.raises(SystemExit) as exc:
+            self._main(["--changed", "definitely-not-a-ref"])
+        assert exc.value.code == 2
+
+    def test_changed_reports_subset_but_indexes_whole_repo(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """--changed filters the REPORT to touched files while the
+        index still spans everything — the cross-module finding for a
+        changed applier module lands in the (unchanged) definer, so it
+        must survive the filter only when its anchor file changed."""
+        import subprocess
+
+        _write(
+            tmp_path,
+            "liba.py",
+            """
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+            """,
+        )
+        _write(
+            tmp_path,
+            "libb.py",
+            """
+            import jax
+            import liba
+
+            fast = jax.jit(liba.step)
+            """,
+        )
+        subprocess.run(
+            ["git", "init", "-q"], cwd=tmp_path, check=True
+        )
+        subprocess.run(
+            ["git", "add", "-A"], cwd=tmp_path, check=True
+        )
+        subprocess.run(
+            [
+                "git", "-c", "user.email=t@t", "-c", "user.name=t",
+                "commit", "-qm", "seed",
+            ],
+            cwd=tmp_path,
+            check=True,
+        )
+        # touch only libb (the APPLIER): the ZNC001 finding anchors in
+        # liba, which did not change — the filtered report is empty,
+        # but a full report still carries it
+        (tmp_path / "libb.py").write_text(
+            (tmp_path / "libb.py").read_text() + "\n# touched\n"
+        )
+        rc = self._main(
+            [
+                str(tmp_path),
+                "--root",
+                str(tmp_path),
+                "--no-baseline",
+                "--changed",
+                "HEAD",
+                "--format",
+                "json",
+            ]
+        )
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == []
+        # now touch liba too: the finding's anchor is in the changed
+        # set and must be reported
+        (tmp_path / "liba.py").write_text(
+            (tmp_path / "liba.py").read_text() + "\n# touched\n"
+        )
+        rc = self._main(
+            [
+                str(tmp_path),
+                "--root",
+                str(tmp_path),
+                "--no-baseline",
+                "--changed",
+                "HEAD",
+                "--format",
+                "json",
+            ]
+        )
+        assert rc == 1
+        report = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in report] == ["ZNC001"]
+        assert report[0]["path"] == "liba.py"
+
+    def test_changed_rebases_git_paths_onto_root(
+        self, tmp_path, capsys
+    ):
+        """git diff prints toplevel-relative paths; finding paths are
+        --root-relative.  With --root a SUBDIRECTORY of the git
+        toplevel the two frames differ — the filter must still match
+        (review regression: it silently reported 0 findings)."""
+        import subprocess
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+        (pkg / "mod.py").write_text("def f(x):\n    return x\n")
+        subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+        subprocess.run(
+            [
+                "git", "-c", "user.email=t@t", "-c", "user.name=t",
+                "commit", "-qm", "seed",
+            ],
+            cwd=tmp_path,
+            check=True,
+        )
+        (pkg / "mod.py").write_text(
+            "def f():\n"
+            "    try:\n"
+            "        return 1\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        rc = self._main(
+            [
+                str(pkg),
+                "--root",
+                str(pkg),  # root != git toplevel
+                "--no-baseline",
+                "--changed",
+                "HEAD",
+                "--format",
+                "json",
+            ]
+        )
+        assert rc == 1
+        report = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in report] == ["ZNC008"]
+        assert report[0]["path"] == "mod.py"  # root-relative
+
+    def test_write_baseline_refuses_changed_subset(self, tmp_path):
+        import pytest
+
+        with pytest.raises(SystemExit) as exc:
+            self._main(
+                [
+                    "--write-baseline",
+                    "--changed",
+                    "HEAD",
+                    "--baseline",
+                    str(tmp_path / "b.json"),
+                ]
+            )
+        assert exc.value.code == 2
+
+    def test_wall_time_in_summary(self, tmp_path, capsys):
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x\n")
+        rc = self._main(
+            [str(good), "--root", str(tmp_path), "--no-baseline"]
+        )
+        assert rc == 0
+        err = capsys.readouterr().err
+        assert "s]" in err and "finding" in err

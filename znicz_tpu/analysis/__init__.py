@@ -4,8 +4,14 @@ The reference stack had no machine-checkable correctness tooling — unit
 wiring and device plumbing were validated only at runtime (PAPER.md flags
 this as the reconstruction risk).  This subsystem closes the gap for the
 rebuild's dominant *silent* failure modes: tracer leaks, retrace storms,
-``PartitionSpec`` axes that don't exist on the mesh, PRNG key reuse —
-none of which any test tier catches before an expensive TPU run.
+``PartitionSpec`` axes that don't exist on the mesh, PRNG key reuse,
+and serving-tier thread-safety drift (lock-discipline races, silently
+dying background threads) — none of which any test tier catches before
+an expensive TPU run (or a paging incident).  Analysis is
+PROJECT-WIDE (:mod:`znicz_tpu.analysis.project`): transforms applied
+in one module mark functions defined in another, and helpers reachable
+only from traced callers are reported at the traced entry point with
+the call chain.
 
 Usage::
 
@@ -30,5 +36,9 @@ from znicz_tpu.analysis.engine import (  # noqa: F401
     load_baseline,
     new_findings,
     write_baseline,
+)
+from znicz_tpu.analysis.project import (  # noqa: F401
+    ProjectIndex,
+    analyze_project,
 )
 from znicz_tpu.analysis.rules import RULES, get_rules  # noqa: F401

@@ -1,5 +1,11 @@
 """``python -m znicz_tpu.analysis`` — the znicz-check CLI.
 
+Runs the PROJECT-WIDE analysis (:mod:`znicz_tpu.analysis.project`):
+one index over every analyzed module, so a ``jax.jit`` applied in a
+different module than the function definition still marks it traced,
+and helpers reachable only from traced callers are reported at the
+traced entry point.
+
 Exit codes: 0 = clean against the baseline, 1 = new findings (or
 syntax errors), 2 = usage error.
 """
@@ -9,15 +15,18 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import pathlib
+import subprocess
 import sys
+import time
 
 from znicz_tpu.analysis.engine import (
-    analyze_paths,
     load_baseline,
     new_findings,
     stale_baseline_entries,
     write_baseline,
 )
+from znicz_tpu.analysis.project import analyze_project
 from znicz_tpu.analysis.rules import RULES, get_rules
 
 # Anchor defaults to the repo root (the package's parent), NOT the cwd:
@@ -30,17 +39,155 @@ DEFAULT_BASELINE = os.path.join(
     REPO_ROOT, "tools", "znicz_check_baseline.json"
 )
 
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
 
 def _split_ids(value):
     return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def _changed_files(ref: str, root: str):
+    """ROOT-relative posix paths of ``.py`` files touched vs ``ref``:
+    committed + working-tree changes (``git diff``) plus untracked
+    files — what a pre-push hook or an editor wants annotated.  Git
+    prints ``diff`` paths relative to the repo TOP LEVEL and
+    ``ls-files`` paths relative to the cwd, while finding paths are
+    relative to ``--root`` — everything is rebased onto ``root`` here
+    (files outside it are dropped), or the filter would silently never
+    match.  Raises ``RuntimeError`` with git's own message when the
+    ref is bogus."""
+    root = os.path.abspath(root)
+    proc = subprocess.run(
+        ["git", "-C", root, "rev-parse", "--show-toplevel"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            proc.stderr.strip() or f"{root} is not inside a git repo"
+        )
+    toplevel = proc.stdout.strip()
+    out = set()
+    for base, args in (
+        (
+            toplevel,  # git diff paths are always toplevel-relative
+            ["git", "-C", root, "diff", "--name-only", ref, "--", "*.py"],
+        ),
+        (
+            root,  # ls-files paths are cwd-relative (-C root)
+            [
+                "git", "-C", root, "ls-files", "--others",
+                "--exclude-standard", "--", "*.py",
+            ],
+        ),
+    ):
+        proc = subprocess.run(args, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                proc.stderr.strip() or f"git failed: {' '.join(args)}"
+            )
+        for line in proc.stdout.splitlines():
+            if not line.strip():
+                continue
+            rel = os.path.relpath(
+                os.path.join(base, line.strip()), root
+            ).replace(os.sep, "/")
+            if not rel.startswith("../"):
+                out.add(rel)
+    return out
+
+
+def sarif_report(findings, root: str) -> dict:
+    """SARIF 2.1.0 document for CI/editor inline annotation.  Rule
+    metadata comes from the registry; levels map straight off the
+    severity; ``partialFingerprints`` carries the baseline fingerprint
+    so a SARIF consumer's dedup agrees with ours; ``SRCROOT`` resolves
+    to the analysis root so base-honoring viewers open the real
+    files."""
+    seen_rules = sorted({f.rule for f in findings})
+    rules_meta = []
+    for rid in seen_rules:
+        cls = RULES.get(rid)
+        rules_meta.append(
+            {
+                "id": rid,
+                "shortDescription": {
+                    "text": (
+                        cls.title
+                        if cls is not None
+                        else "unparseable module"
+                    )
+                },
+                "defaultConfiguration": {
+                    "level": (
+                        cls.severity if cls is not None else "error"
+                    )
+                },
+            }
+        )
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": f.severity,
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": max(f.col, 1),
+                        },
+                    },
+                    "logicalLocations": [
+                        {"fullyQualifiedName": f.symbol}
+                    ],
+                }
+            ],
+            "partialFingerprints": {"zniczCheck/v1": f.fingerprint},
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "znicz-check",
+                        "informationUri": (
+                            "docs/STATIC_ANALYSIS.md"
+                        ),
+                        "rules": rules_meta,
+                    }
+                },
+                "originalUriBaseIds": {
+                    "SRCROOT": {
+                        "uri": pathlib.Path(
+                            os.path.abspath(root)
+                        ).as_uri()
+                        + "/"
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="znicz-check",
         description=(
-            "AST-based JAX-hygiene & sharding-consistency analyzer "
-            "for the znicz_tpu package"
+            "Project-wide AST-based JAX-hygiene, sharding-consistency "
+            "and serving-tier thread-safety analyzer for the znicz_tpu "
+            "package"
         ),
     )
     parser.add_argument(
@@ -72,7 +219,14 @@ def main(argv=None) -> int:
         "--ignore", type=_split_ids, help="skip these rule IDs"
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text"
+        "--format", choices=("text", "json", "sarif"), default="text"
+    )
+    parser.add_argument(
+        "--changed",
+        metavar="REF",
+        help="report findings only for files touched vs this git ref "
+        "(the project index is still built whole-repo, so "
+        "cross-module results stay correct)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
@@ -93,20 +247,22 @@ def main(argv=None) -> int:
 
     default_target = os.path.join(REPO_ROOT, "znicz_tpu")
     paths = args.paths or [default_target]
-    # "full run" = every rule over the whole package — the only state a
-    # baseline regen (or a stale-entry verdict) is meaningful against
+    # "full run" = every rule over the whole package, unfiltered — the
+    # only state a baseline regen (or a stale-entry verdict) is
+    # meaningful against
     full_run = (
-        not (args.select or args.ignore)
+        not (args.select or args.ignore or args.changed)
         and {os.path.abspath(p) for p in paths}
         == {os.path.abspath(default_target)}
     )
 
     if args.write_baseline and not full_run:
-        # a partial regen (rule or path subset) would silently erase
-        # every other rule's/file's grandfathered entries
+        # a partial regen (rule, path or changed-file subset) would
+        # silently erase every other rule's/file's grandfathered entries
         parser.error(
             "--write-baseline requires a full run (all rules, default "
-            "paths); drop --select/--ignore and positional paths"
+            "paths); drop --select/--ignore/--changed and positional "
+            "paths"
         )
 
     try:
@@ -114,10 +270,24 @@ def main(argv=None) -> int:
     except ValueError as exc:
         parser.error(str(exc))
 
+    report_paths = None
+    if args.changed is not None:
+        try:
+            report_paths = _changed_files(args.changed, args.root)
+        except (RuntimeError, OSError) as exc:
+            parser.error(f"--changed {args.changed}: {exc}")
+
+    t0 = time.monotonic()
     try:
-        findings = analyze_paths(paths, root=args.root, rules=rules)
+        findings, _index = analyze_project(
+            paths,
+            root=args.root,
+            rules=rules,
+            report_paths=report_paths,
+        )
     except FileNotFoundError as exc:
         parser.error(str(exc))
+    wall_s = time.monotonic() - t0
 
     if args.write_baseline:
         write_baseline(findings, args.baseline)
@@ -135,12 +305,9 @@ def main(argv=None) -> int:
     )
 
     if args.format == "json":
-        print(
-            json.dumps(
-                [f.__dict__ for f in report],
-                indent=2,
-            )
-        )
+        print(json.dumps([f.__dict__ for f in report], indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(sarif_report(report, args.root), indent=2))
     else:
         for f in report:
             print(f.format())
@@ -148,9 +315,9 @@ def main(argv=None) -> int:
         summary = f"{len(report)} new finding(s)"
         if baseline is not None:
             summary += f", {suppressed} baselined"
-            # on a rule/path subset most baselined entries didn't get a
-            # chance to fire, so "stale" would be meaningless (and the
-            # recommended regen destructive)
+            # on a rule/path/changed subset most baselined entries
+            # didn't get a chance to fire, so "stale" would be
+            # meaningless (and the recommended regen destructive)
             stale = (
                 stale_baseline_entries(findings, baseline)
                 if full_run
@@ -161,6 +328,12 @@ def main(argv=None) -> int:
                     f"; {sum(stale.values())} baseline entr(ies) no "
                     "longer fire — regenerate with --write-baseline"
                 )
+        if report_paths is not None:
+            summary += (
+                f" in {len(report_paths)} changed file(s) "
+                f"vs {args.changed}"
+            )
+        summary += f" [{wall_s:.2f}s]"
         print(summary, file=sys.stderr)
 
     return 1 if report else 0

@@ -11,12 +11,16 @@ there) when the function is:
 * lexically nested inside any traced function (closures like a scan
   body defined inside a jitted step).
 
-This is a static approximation: helpers that are only *called* from
-traced code (e.g. ``ops.dropout``) are not marked — the rules catch the
-hazard at the traced caller instead.  Static arguments declared via
-``static_argnums`` / ``static_argnames`` (literal values only) are
-excluded from the traced-parameter sets, so branching on a static
-config flag inside a jitted function does not fire ZNC001.
+The index is built per module; the PROJECT-wide pass
+(:mod:`znicz_tpu.analysis.project`) extends it across imports by
+calling :meth:`TracedIndex.mark_traced` on the defining module's index
+for every transform applied elsewhere (``jax.jit(workflow.step)`` in a
+bench marks ``step`` traced in ``workflow``), and by chain-marking
+module-level helpers reachable only from traced callers.  Static
+arguments declared via ``static_argnums`` / ``static_argnames``
+(literal values only) are excluded from the traced-parameter sets, so
+branching on a static config flag inside a jitted function does not
+fire ZNC001.
 """
 
 from __future__ import annotations
@@ -93,6 +97,70 @@ def _literal_tuple(node: ast.AST) -> Optional[Tuple]:
                 return None
         return tuple(vals)
     return None
+
+
+def scope_local_names(fn) -> Set[str]:
+    """Parameters plus every name the function itself binds — python
+    scoping makes such a name local THROUGHOUT the function, so a load
+    of it can never refer to a module-level def or variable."""
+    names: Set[str] = set(_param_names(fn))
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)  # the def statement binds its name
+            continue  # nested scopes bind their own names
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+def name_is_shadowed(info, node: ast.AST, name: str) -> bool:
+    """Is ``name``, read at ``node``, bound by an enclosing function
+    scope (parameter, local assignment, nested def)?  A shadowed name
+    can never resolve to the module-level def of the same name."""
+    fn = info.enclosing_function(node)
+    while fn is not None:
+        if name in scope_local_names(fn):
+            return True
+        fn = info.enclosing_function(fn)
+    return False
+
+
+def name_is_param(info, node: ast.AST, name: str) -> bool:
+    """Is ``name``, read at ``node``, a PARAMETER of an enclosing
+    function?  ``jax.jit(step)`` inside ``def compile_it(step)`` wraps
+    whatever the caller passed — never the module-level ``step`` def.
+    (Weaker than :func:`name_is_shadowed` on purpose: nested-def names
+    must stay resolvable for scan-body/closure patterns.)"""
+    fn = info.enclosing_function(node)
+    while fn is not None:
+        if name in _param_names(fn):
+            return True
+        fn = info.enclosing_function(fn)
+    return False
+
+
+def unwrap_partial(info, node: ast.AST):
+    """``partial(body, ...)`` -> ``(body, n_positional_bound,
+    keyword_bound_names)``; anything else passes through with zero
+    bindings.  The ONE owner of partial-unwrapping semantics — the
+    per-module traced index and the project pass both call it, so the
+    two can never diverge on what a partial binds."""
+    if (
+        isinstance(node, ast.Call)
+        and _basename(info.resolved(node.func)) == "partial"
+        and node.args
+    ):
+        kwnames = {kw.arg for kw in node.keywords if kw.arg}
+        return node.args[0], len(node.args) - 1, kwnames
+    return node, 0, set()
 
 
 def _param_names(fn) -> List[str]:
@@ -223,17 +291,13 @@ class TracedIndex:
         parameters — are trace-time CONSTANTS, so they join the static
         set rather than the traced one.
         """
-        n_pos, kwnames = 0, set()
-        if (
-            isinstance(node, ast.Call)
-            and _basename(self.info.resolved(node.func)) == "partial"
-            and node.args
-        ):
-            n_pos = len(node.args) - 1
-            kwnames = {kw.arg for kw in node.keywords if kw.arg}
-            node = node.args[0]
+        node, n_pos, kwnames = unwrap_partial(self.info, node)
         out = []
         if isinstance(node, ast.Name):
+            if site is not None and name_is_param(
+                self.info, site, node.id
+            ):
+                return []  # wraps whatever the caller passed in
             for fn in self._defs_by_name.get(node.id, []):
                 if site is not None and not self._visible_from(fn, site):
                     continue
@@ -294,6 +358,15 @@ class TracedIndex:
                             node.args[i], node
                         ):
                             self._mark(fn, bound)
+
+    # -- the project pass's entry point ----------------------------------
+    def mark_traced(self, fn, static: Set[str]) -> None:
+        """Mark ``fn`` traced with ``static`` parameter names excluded
+        — the cross-module hook :mod:`znicz_tpu.analysis.project` uses
+        when a transform application in ANOTHER module resolves to a
+        def in this one.  Closures nested in ``fn`` are marked too,
+        exactly like a same-module application."""
+        self._mark(fn, static)
 
     # -- queries ---------------------------------------------------------
     def is_traced(self, fn) -> bool:

@@ -222,7 +222,12 @@ def analyze_source(
     rules: Optional[Sequence] = None,
     root: Optional[str] = None,
 ) -> List[Finding]:
-    """Analyze one module's source; pragma suppression applied."""
+    """Analyze one module's source; pragma suppression applied.
+
+    PER-MODULE view only: transform applications in other modules are
+    invisible here.  The CLI and the tier-1 gate run
+    :func:`znicz_tpu.analysis.project.analyze_project` instead, which
+    cross-module-marks every ModuleInfo before the rules see it."""
     from znicz_tpu.analysis.rules import get_rules
 
     info = ModuleInfo(source, path, root)
@@ -260,9 +265,11 @@ def analyze_paths(
     root: Optional[str] = None,
     rules: Optional[Sequence] = None,
 ) -> List[Finding]:
-    """Analyze every ``.py`` under ``paths``.  Finding paths (and thus
-    fingerprints) are relative to ``root`` (default: cwd) with posix
-    separators, so baselines are machine-independent."""
+    """Analyze every ``.py`` under ``paths``, each module in
+    isolation (see :func:`analyze_source` for the project-wide
+    alternative).  Finding paths (and thus fingerprints) are relative
+    to ``root`` (default: cwd) with posix separators, so baselines are
+    machine-independent."""
     if rules is None:
         from znicz_tpu.analysis.rules import get_rules
 

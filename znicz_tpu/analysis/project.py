@@ -1,0 +1,479 @@
+"""Project-wide analysis: cross-module transforms and the call graph.
+
+The per-module :class:`~znicz_tpu.analysis.context.TracedIndex` only
+sees transform applications spelled in the SAME module as the function
+definition — ``jax.jit(step)`` in ``bench.py`` where ``step`` lives in
+``workflow/standard.py`` used to mark nothing (the ROADMAP's carried
+"same-module caveat").  This module is the whole-project upgrade:
+
+* **Symbol table** — every ``.py`` under the analyzed tree is parsed
+  once; a dotted-name index maps ``znicz_tpu.workflow.standard.step``
+  to the ``FunctionDef`` that owns it (module-level functions and
+  one-level class methods), resolving each module's own import aliases.
+* **Cross-module transform propagation** — every ``jax.jit(f)`` /
+  ``grad(f)`` / ``lax.scan(body, ...)`` call-form application is
+  resolved against the symbol table; when the target lives in a
+  DIFFERENT module, the target's own :class:`TracedIndex` is marked, so
+  ZNC001/ZNC002/ZNC006 fire inside the definition no matter where the
+  transform was applied.  ``static_argnums``/``static_argnames`` and
+  ``partial``-bound names are honored exactly like the local pass.
+* **Call graph + chain marking** — a module-level helper reachable
+  ONLY from traced callers (every project-internal call site sits in
+  traced code) is itself analyzed as traced: its parameters are
+  classified traced/static from what the call sites actually pass
+  (a literal stays static; a traced name makes the parameter traced),
+  and any finding inside it is RE-ANCHORED to the traced entry point
+  with the call chain in the message — the hazard is reported where
+  the tracer enters, which is where the fix (a static arg, a
+  ``lax.cond``) must be applied.
+
+The pass is still a static approximation: helpers also called from
+host code stay unmarked (the host call sites prove a concrete-Python
+contract exists), methods reached through ``self`` are out of scope,
+and dynamic dispatch is invisible.  Everything here is pure stdlib
+``ast`` — importing this module must never pull in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from znicz_tpu.analysis.context import (
+    _param_names,
+    _positional_names,
+    _static_names_from_kwargs,
+    name_is_shadowed,
+    unwrap_partial,
+)
+from znicz_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    iter_py_files,
+)
+
+# rules whose findings inside a chain-marked helper are re-anchored to
+# the traced entry point (the rules that key on traced context)
+CHAIN_RULES = ("ZNC001", "ZNC002", "ZNC006")
+
+
+def module_name(rel_path: str) -> str:
+    """``znicz_tpu/services/engine.py`` -> ``znicz_tpu.services.engine``
+    (posix separators; ``__init__.py`` names the package itself)."""
+    name = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    elif name == "__init__":
+        name = ""
+    return name
+
+
+def _expr_uses(node: ast.AST, names: Set[str]) -> bool:
+    """Does the expression read any of ``names``?"""
+    return any(
+        isinstance(n, ast.Name) and n.id in names
+        for n in ast.walk(node)
+    )
+
+
+class _Chain:
+    """One helper marked traced through the call graph."""
+
+    __slots__ = ("info", "fn", "qual", "chain", "entry_info", "entry_fn")
+
+    def __init__(self, info, fn, qual):
+        self.info = info  # ModuleInfo owning the helper
+        self.fn = fn  # the helper's FunctionDef
+        self.qual = qual  # "module.helper"
+        self.chain: List[str] = []  # entry ... helper qualnames
+        self.entry_info: Optional[ModuleInfo] = None
+        self.entry_fn = None  # the traced entry FunctionDef
+
+    def contains(self, line: int) -> bool:
+        end = getattr(self.fn, "end_lineno", self.fn.lineno)
+        return self.fn.lineno <= line <= end
+
+
+class ProjectIndex:
+    """Parsed project + cross-module traced-context propagation.
+
+    Build with :meth:`build`; the per-module :class:`ModuleInfo`
+    objects (``.modules``, keyed by repo-relative path) already carry
+    the cross-module marks when construction returns, so running the
+    ordinary rules over them IS the project-wide analysis.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {}  # rel path -> info
+        self.by_name: Dict[str, ModuleInfo] = {}  # dotted name -> info
+        # dotted module name -> {qualname -> FunctionDef}: module-level
+        # functions plus one-level class methods
+        self.defs: Dict[str, Dict[str, ast.AST]] = {}
+        self.syntax_findings: List[Finding] = []
+        # cross-module transform applications, for introspection/tests:
+        # {"transform", "site", "site_line", "target"}
+        self.applications: List[Dict] = []
+        self._chains: List[_Chain] = []
+        # (id(fn)) -> _Chain for entry resolution through nested chains
+        self._chain_by_fn: Dict[int, _Chain] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(
+        cls, paths: Sequence[str], root: Optional[str] = None
+    ) -> "ProjectIndex":
+        root = os.path.abspath(root or os.getcwd())
+        index = cls(root)
+        for file in iter_py_files(paths):
+            rel = os.path.relpath(os.path.abspath(file), root).replace(
+                os.sep, "/"
+            )
+            with open(file, encoding="utf-8") as f:
+                source = f.read()
+            index.add_module(source, rel)
+        index.link()
+        return index
+
+    def add_module(self, source: str, rel_path: str) -> None:
+        """Parse one module into the index (syntax errors become
+        ZNC000 findings, exactly like the per-file engine)."""
+        try:
+            info = ModuleInfo(source, rel_path, self.root)
+        except SyntaxError as exc:
+            self.syntax_findings.append(
+                Finding(
+                    rule="ZNC000",
+                    severity="error",
+                    path=rel_path,
+                    line=exc.lineno or 0,
+                    col=(exc.offset or 0),
+                    message=f"syntax error: {exc.msg}",
+                    symbol="<module>",
+                    snippet="",
+                )
+            )
+            return
+        self.modules[rel_path] = info
+        name = module_name(rel_path)
+        self.by_name[name] = info
+        defs: Dict[str, ast.AST] = {}
+        for node in info.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        defs[f"{node.name}.{sub.name}"] = sub
+        self.defs[name] = defs
+
+    def link(self) -> None:
+        """Resolve cross-module transform applications, then chain-mark
+        traced-only helpers.  Idempotent per build."""
+        self._link_transforms()
+        self._chain_mark()
+
+    # -- symbol resolution -------------------------------------------------
+
+    def resolve_symbol(
+        self, dotted: Optional[str], home: Optional[ModuleInfo] = None
+    ) -> Optional[Tuple[ModuleInfo, ast.AST]]:
+        """``pkg.mod.fn`` (alias-resolved) -> (owning ModuleInfo,
+        FunctionDef), via the longest known module-name prefix.  A bare
+        name resolves against ``home``'s own module-level defs."""
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            if home is None:
+                return None
+            name = module_name(home.path)
+            fn = self.defs.get(name, {}).get(dotted)
+            return (home, fn) if fn is not None else None
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            info = self.by_name.get(mod)
+            if info is None:
+                continue
+            fn = self.defs[mod].get(".".join(parts[i:]))
+            return (info, fn) if fn is not None else None
+        return None
+
+    def _resolve_callable(
+        self, info: ModuleInfo, node: ast.AST
+    ) -> List[Tuple[ModuleInfo, ast.AST, Set[str]]]:
+        """A transform's callable argument -> [(owning module, def,
+        partial-bound names)], cross-module.  Shares the local pass's
+        ``partial(body, ...)`` unwrapping (names the partial binds are
+        trace-time constants)."""
+        node, n_pos, kwnames = unwrap_partial(info, node)
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            return []
+        if isinstance(node, ast.Name) and name_is_shadowed(
+            info, node, node.id
+        ):
+            return []  # a parameter/local, never the module-level def
+        hit = self.resolve_symbol(info.resolved(node), home=info)
+        if hit is None:
+            return []
+        tinfo, fn = hit
+        bound = set(kwnames)
+        bound.update(_positional_names(fn)[:n_pos])
+        return [(tinfo, fn, bound)]
+
+    # -- cross-module transforms -------------------------------------------
+
+    def _link_transforms(self) -> None:
+        from znicz_tpu.analysis.context import LAX_BODIES
+
+        for info in self.modules.values():
+            ti = info.traced
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                base, kws = ti._wrapper_call(node)
+                if base is not None and node.args:
+                    for tinfo, fn, bound in self._resolve_callable(
+                        info, node.args[0]
+                    ):
+                        if tinfo is info:
+                            continue  # the local pass already saw it
+                        static = set(bound)
+                        static |= _static_names_from_kwargs(fn, kws)
+                        tinfo.traced.mark_traced(fn, static)
+                        self._record(base, info, node, tinfo, fn)
+                    continue
+                lax_name = (info.resolved(node.func) or "").rpartition(
+                    "."
+                )[2]
+                head = (info.resolved(node.func) or "").rpartition(".")[0]
+                body_slots = (
+                    LAX_BODIES.get(lax_name)
+                    if head
+                    in (
+                        "jax",
+                        "lax",
+                        "jax.lax",
+                    )
+                    else None
+                )
+                if body_slots:
+                    for i in body_slots:
+                        if i < len(node.args):
+                            for tinfo, fn, bound in self._resolve_callable(
+                                info, node.args[i]
+                            ):
+                                if tinfo is info:
+                                    continue
+                                tinfo.traced.mark_traced(fn, set(bound))
+                                self._record(
+                                    lax_name, info, node, tinfo, fn
+                                )
+
+    def _record(self, transform, info, node, tinfo, fn) -> None:
+        self.applications.append(
+            {
+                "transform": transform,
+                "site": info.path,
+                "site_line": getattr(node, "lineno", 0),
+                "target": f"{module_name(tinfo.path)}."
+                f"{tinfo.qualname(fn)}",
+            }
+        )
+
+    # -- call graph + chain marking ----------------------------------------
+
+    def _call_sites(self) -> Dict[int, List[Tuple[ModuleInfo, ast.Call]]]:
+        """Project-internal call sites per callee: id(def) ->
+        [(caller module, call node)].  Only plain-function calls that
+        resolve through the symbol table; ``self.m()`` dispatch and
+        anything dynamic stays invisible (conservative)."""
+        sites: Dict[int, List[Tuple[ModuleInfo, ast.Call]]] = {}
+        self._def_meta: Dict[int, Tuple[ModuleInfo, ast.AST]] = {}
+        for info in self.modules.values():
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not isinstance(
+                    node.func, (ast.Name, ast.Attribute)
+                ):
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    # self.m() / obj.m(): method dispatch, out of scope
+                    base = node.func.value
+                    while isinstance(base, ast.Attribute):
+                        base = base.value
+                    if not isinstance(base, ast.Name):
+                        continue
+                    if (
+                        base.id not in info.import_aliases
+                        and base.id not in info.from_imports
+                    ):
+                        continue
+                elif name_is_shadowed(info, node.func, node.func.id):
+                    # `outer(x, helper)` calling its PARAMETER must not
+                    # be attributed to an unrelated module-level def of
+                    # the same name (and then chain-marked off it)
+                    continue
+                hit = self.resolve_symbol(
+                    info.resolved(node.func), home=info
+                )
+                if hit is None or hit[1] is None:
+                    continue
+                tinfo, fn = hit
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    continue  # awaited elsewhere; not a sync chain
+                sites.setdefault(id(fn), []).append((info, node))
+                self._def_meta[id(fn)] = (tinfo, fn)
+        return sites
+
+    def _site_traced_params(
+        self, caller_info: ModuleInfo, call: ast.Call, fn
+    ) -> Set[str]:
+        """Which of ``fn``'s parameters receive traced values at this
+        call site.  Literals and names outside the caller's traced set
+        stay static — so ``helper(x, training=False)`` from a jitted
+        caller marks only ``x`` traced."""
+        traced = caller_info.traced.traced_param_names(call)
+        pos = _positional_names(fn)
+        vararg = fn.args.vararg.arg if fn.args.vararg else None
+        out: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                out.update(pos[i:])  # unknown spread: conservative
+                break
+            name = pos[i] if i < len(pos) else vararg
+            if name and _expr_uses(arg, traced):
+                out.add(name)
+        for kw in call.keywords:
+            if kw.arg and _expr_uses(kw.value, traced):
+                out.add(kw.arg)
+        return out
+
+    def _chain_mark(self) -> None:
+        sites = self._call_sites()
+        changed = True
+        while changed:
+            changed = False
+            for fid, callers in sites.items():
+                tinfo, fn = self._def_meta[fid]
+                if tinfo.traced.is_traced(fn):
+                    continue
+                if not all(
+                    cinfo.traced.in_traced_code(call)
+                    for cinfo, call in callers
+                ):
+                    continue
+                traced_params: Set[str] = set()
+                for cinfo, call in callers:
+                    traced_params |= self._site_traced_params(
+                        cinfo, call, fn
+                    )
+                static = set(_param_names(fn)) - traced_params
+                tinfo.traced.mark_traced(fn, static)
+                qual = f"{module_name(tinfo.path)}.{tinfo.qualname(fn)}"
+                chain = _Chain(tinfo, fn, qual)
+                # entry: the first call site's own chain, extended
+                cinfo, call = callers[0]
+                caller_fn = cinfo.enclosing_function(call)
+                prior = self._chain_by_fn.get(id(caller_fn))
+                if prior is not None and prior.entry_fn is not None:
+                    chain.entry_info = prior.entry_info
+                    chain.entry_fn = prior.entry_fn
+                    chain.chain = prior.chain + [qual]
+                else:
+                    chain.entry_info = cinfo
+                    chain.entry_fn = caller_fn
+                    caller_qual = (
+                        f"{module_name(cinfo.path)}."
+                        f"{cinfo.qualname(call)}"
+                    )
+                    chain.chain = [caller_qual, qual]
+                self._chains.append(chain)
+                self._chain_by_fn[id(fn)] = chain
+                changed = True
+
+    # -- finding post-processing -------------------------------------------
+
+    def chains(self) -> List[Dict]:
+        """Chain-marked helpers, for tests/introspection."""
+        return [
+            {"helper": c.qual, "chain": list(c.chain), "path": c.info.path}
+            for c in self._chains
+        ]
+
+    def relocate(self, findings: Iterable[Finding]) -> List[Finding]:
+        """Re-anchor traced-context findings that sit inside a
+        chain-marked helper to the traced ENTRY point, carrying the
+        call chain (and the helper's real location) in the message —
+        the entry is where the fix applies."""
+        out: List[Finding] = []
+        for f in findings:
+            chain = None
+            if f.rule in CHAIN_RULES:
+                for c in self._chains:
+                    if c.info.path == f.path and c.contains(f.line):
+                        chain = c
+                        break
+            if chain is None or chain.entry_fn is None:
+                out.append(f)
+                continue
+            einfo, efn = chain.entry_info, chain.entry_fn
+            out.append(
+                Finding(
+                    rule=f.rule,
+                    severity=f.severity,
+                    path=einfo.path,
+                    line=efn.lineno,
+                    col=efn.col_offset + 1,
+                    message=(
+                        f"{f.message} [in helper '{chain.qual}' at "
+                        f"{f.path}:{f.line}, reachable only from traced "
+                        f"code via {' -> '.join(chain.chain)}]"
+                    ),
+                    symbol=einfo.qualname(efn),
+                    snippet=einfo.snippet(efn.lineno),
+                )
+            )
+        out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return out
+
+
+def analyze_project(
+    paths: Sequence[str],
+    *,
+    root: Optional[str] = None,
+    rules: Optional[Sequence] = None,
+    report_paths: Optional[Set[str]] = None,
+) -> Tuple[List[Finding], ProjectIndex]:
+    """Whole-project analysis: one :class:`ProjectIndex` over every
+    ``.py`` under ``paths``, the ordinary rules run per module against
+    the cross-module-marked trees, chain findings re-anchored.
+
+    ``report_paths`` (repo-relative, posix) restricts which files'
+    findings are RETURNED — the index is still built over everything,
+    so cross-module results stay correct (the ``--changed`` contract).
+    Returns ``(findings, index)``.
+    """
+    if rules is None:
+        from znicz_tpu.analysis.rules import get_rules
+
+        rules = get_rules()
+    root = os.path.abspath(root or os.getcwd())
+    index = ProjectIndex.build(paths, root)
+    findings: List[Finding] = list(index.syntax_findings)
+    for info in index.modules.values():
+        for rule in rules:
+            for finding in rule.check(info):
+                if not info.suppressed(finding):
+                    findings.append(finding)
+    findings = index.relocate(findings)
+    if report_paths is not None:
+        findings = [f for f in findings if f.path in report_paths]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, index

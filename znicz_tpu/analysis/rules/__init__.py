@@ -54,10 +54,12 @@ from znicz_tpu.analysis.rules import (  # noqa: E402,F401
     exceptions,
     host_effects,
     host_sync,
+    lock_discipline,
     metric_names,
     mutable_state,
     prng_keys,
     sharding_axes,
+    thread_exceptions,
     traced_branch,
     wallclock,
 )

@@ -1,0 +1,378 @@
+"""ZNC012: lock-discipline races in the serving tier.
+
+Every race the serving tier has shipped so far (the SLOMonitor ring
+mutated during iteration, the router's request tally, ``rank()``
+reading two affinity-index states) had the same shape: a class that
+owns (or is driven by) threads protects an attribute with ``with
+self._lock`` in SOME methods and touches it bare in others.  Human
+review caught each one; this rule makes the pattern mechanical.
+
+Scope: classes in ``services/``, ``cluster/`` and ``observability/``
+modules that declare at least one lock attribute (``self.X =
+threading.Lock()`` / ``RLock()`` / ``Condition()``, or an attribute
+with "lock" in its name used as a ``with self.X:`` context — the lock
+is the author's own declaration that the class is shared).  For each
+such class the rule:
+
+* collects every ``self.<attr>`` access per method, classified as
+  **write** (assignment / augmented assignment), **mutate** (a call of
+  a known container mutator — ``append``, ``pop``, ``update``,
+  ``clear``, ... — or a subscript store/delete), **iterate**
+  (``for x in self.a``, a comprehension source, ``list(self.a)`` /
+  ``sorted(...)`` / ``.values()``-family views) or **read** (anything
+  else);
+* computes which *thread roots* reach each method: a
+  ``threading.Thread(target=self.m)`` target seeds a per-thread root,
+  public methods (and dunders other than ``__init__``) seed the
+  many-threaded ``client`` root, and roots propagate along the
+  intra-class ``self.m()`` call graph;
+* treats a private method whose every intra-class call site holds the
+  lock as lock-held itself (the repo's documented "lock held by the
+  caller" convention);
+* fires on any **bare write/mutate/iterate** of an attribute that is
+  accessed under the lock somewhere else, when the attribute's
+  audience spans more than one root (or the inherently concurrent
+  ``client`` root alone).
+
+Stays quiet on: plain reads (attribute loads are atomic in CPython —
+reading a lock-guarded counter without the lock is stale, not torn),
+``__init__`` writes (the object is not shared yet), attributes only
+ever touched by one dedicated thread, and classes with no lock (they
+declare no discipline to violate).  A deliberate bare access (e.g. an
+atomic flag store) is exempted inline with
+``# znicz-check: disable=ZNC012 -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, NamedTuple, Optional, Set
+
+from znicz_tpu.analysis.rules import Rule, register
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+}
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "extend",
+    "extendleft",
+    "insert",
+    "remove",
+    "pop",
+    "popleft",
+    "popitem",
+    "clear",
+    "update",
+    "add",
+    "discard",
+    "setdefault",
+    "sort",
+    "reverse",
+}
+# calls that drain their (sole) iterable argument
+_ITER_CALLS = {"list", "tuple", "set", "sorted", "frozenset", "dict"}
+# attribute calls returning live iteration views
+_VIEW_CALLS = {"values", "keys", "items"}
+
+_KIND_VERB = {
+    "write": "written",
+    "mutate": "mutated",
+    "iterate": "iterated",
+}
+
+
+class _Access(NamedTuple):
+    attr: str
+    method: str
+    node: ast.AST
+    kind: str
+    locked: bool
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassModel:
+    """Per-class indexes the detector reasons over."""
+
+    def __init__(self, info, cls: ast.ClassDef):
+        self.info = info
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs = self._find_lock_attrs()
+        self.thread_targets = self._find_thread_targets()
+        # self.m() call sites: method -> [(callee, locked)]
+        self.calls: Dict[str, List] = {m: [] for m in self.methods}
+        self.accesses: List[_Access] = []
+        if self.lock_attrs:
+            for name, fn in self.methods.items():
+                self._scan_method(name, fn)
+        self.lock_held = self._lock_held_methods()
+        self.roots = self._method_roots()
+
+    # -- structure discovery ----------------------------------------------
+
+    def _find_lock_attrs(self) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(self.cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                resolved = self.info.resolved(node.value.func)
+                if resolved in _LOCK_FACTORIES:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            out.add(attr)
+            elif isinstance(node, ast.With):
+                # a lock handed in from outside (``self._lock =
+                # registry._lock``) still declares the discipline when
+                # it is USED as one
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr and "lock" in attr.lower():
+                        out.add(attr)
+        return out
+
+    def _find_thread_targets(self) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(self.cls):
+            if not isinstance(node, ast.Call):
+                continue
+            if self.info.resolved(node.func) != "threading.Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr:
+                        out.add(attr)
+        return out
+
+    # -- per-method scanning ----------------------------------------------
+
+    def _is_locked(self, node: ast.AST, fn: ast.AST) -> bool:
+        cur = self.info.parents.get(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in self.lock_attrs:
+                        return True
+            cur = self.info.parents.get(cur)
+        return False
+
+    def _classify(self, node: ast.Attribute) -> str:
+        parents = self.info.parents
+        parent = parents.get(node)
+        # self.a = v / self.a += v / self.a: T = v
+        if isinstance(parent, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                parent.targets
+                if isinstance(parent, ast.Assign)
+                else [parent.target]
+            )
+            if node in targets:
+                return "write"
+        if isinstance(parent, ast.Tuple) and isinstance(
+            node.ctx, ast.Store
+        ):
+            return "write"  # tuple-unpacking target
+        # self.a[k] = v / del self.a[k]
+        if (
+            isinstance(parent, ast.Subscript)
+            and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))
+        ):
+            return "mutate"
+        # self.a.append(...) and friends; .values()/.keys()/.items()
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.value is node
+            and isinstance(parents.get(parent), ast.Call)
+            and parents.get(parent).func is parent
+        ):
+            if parent.attr in _MUTATORS:
+                return "mutate"
+            if parent.attr in _VIEW_CALLS:
+                return "iterate"
+        # for x in self.a / comprehension over self.a
+        if isinstance(parent, (ast.For, ast.AsyncFor)) and parent.iter is node:
+            return "iterate"
+        if isinstance(parent, ast.comprehension) and parent.iter is node:
+            return "iterate"
+        # list(self.a), sorted(self.a), ...
+        if (
+            isinstance(parent, ast.Call)
+            and node in parent.args
+            and isinstance(parent.func, ast.Name)
+            and parent.func.id in _ITER_CALLS
+        ):
+            return "iterate"
+        return "read"
+
+    def _scan_method(self, name: str, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee in self.methods:
+                    self.calls[name].append(
+                        (callee, self._is_locked(node, fn))
+                    )
+            attr = _self_attr(node)
+            if (
+                attr is None
+                or attr in self.lock_attrs
+                or attr in self.methods
+            ):
+                continue
+            self.accesses.append(
+                _Access(
+                    attr,
+                    name,
+                    node,
+                    self._classify(node),
+                    self._is_locked(node, fn),
+                )
+            )
+
+    # -- derived facts -----------------------------------------------------
+
+    def _lock_held_methods(self) -> Set[str]:
+        """Private methods whose every intra-class call site holds the
+        lock (>= 1 site): their bodies run under the caller's lock."""
+        held: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            incoming: Dict[str, List[bool]] = {}
+            for caller, edges in self.calls.items():
+                for callee, locked in edges:
+                    incoming.setdefault(callee, []).append(
+                        locked or caller in held
+                    )
+            for name in self.methods:
+                if name in held or not name.startswith("_"):
+                    continue
+                if name in self.thread_targets or name.startswith("__"):
+                    continue
+                sites = incoming.get(name, [])
+                if sites and all(sites):
+                    held.add(name)
+                    changed = True
+        return held
+
+    def _method_roots(self) -> Dict[str, Set[str]]:
+        roots: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        for name in self.methods:
+            if name == "__init__":
+                continue
+            if name in self.thread_targets:
+                roots[name].add(f"thread:{name}")
+            elif not name.startswith("_") or (
+                name.startswith("__") and name.endswith("__")
+            ):
+                roots[name].add("client")
+        changed = True
+        while changed:
+            changed = False
+            for caller, edges in self.calls.items():
+                for callee, _ in edges:
+                    if callee in roots and not roots[caller] <= roots[
+                        callee
+                    ]:
+                        roots[callee] |= roots[caller]
+                        changed = True
+        return roots
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "ZNC012"
+    severity = "warning"
+    title = (
+        "lock-guarded attribute accessed without the lock in a "
+        "multi-threaded serving-tier class"
+    )
+
+    _SCOPES = ("/services/", "/cluster/", "/observability/")
+
+    def _in_scope(self, info) -> bool:
+        path = f"/{info.path}".replace("\\", "/")
+        return any(scope in path for scope in self._SCOPES)
+
+    def check(self, info) -> Iterable:
+        if not self._in_scope(info):
+            return
+        for cls in info.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            model = _ClassModel(info, cls)
+            if not model.lock_attrs:
+                continue
+            # an attribute nobody writes after __init__ is immutable
+            # config: iterating it bare cannot race (windows tuples,
+            # label-name tuples), whatever lock its neighbours hold
+            mutable_attrs = {
+                acc.attr
+                for acc in model.accesses
+                if acc.kind in ("write", "mutate")
+                and acc.method != "__init__"
+            }
+            guarded: Dict[str, List[_Access]] = {}
+            for acc in model.accesses:
+                if acc.attr not in mutable_attrs:
+                    continue
+                if acc.locked or acc.method in model.lock_held:
+                    guarded.setdefault(acc.attr, []).append(acc)
+            if not guarded:
+                continue
+            audience: Dict[str, Set[str]] = {}
+            for acc in model.accesses:
+                if acc.attr in guarded and acc.method != "__init__":
+                    audience.setdefault(acc.attr, set()).update(
+                        model.roots.get(acc.method, set())
+                    )
+            for acc in model.accesses:
+                if (
+                    acc.attr not in guarded
+                    or acc.locked
+                    or acc.method in model.lock_held
+                    or acc.method == "__init__"
+                    or acc.kind not in _KIND_VERB
+                ):
+                    continue
+                aud = audience.get(acc.attr, set())
+                if not (len(aud) >= 2 or aud == {"client"}):
+                    continue  # a single dedicated thread: no race
+                lock = sorted(model.lock_attrs)[0]
+                where = sorted(
+                    {
+                        g.method
+                        for g in guarded[acc.attr]
+                    }
+                )
+                yield self.finding(
+                    info,
+                    acc.node,
+                    f"'self.{acc.attr}' is {_KIND_VERB[acc.kind]} here "
+                    f"without the lock, but is guarded by "
+                    f"'self.{lock}' in {', '.join(where)} and reachable "
+                    f"from {', '.join(sorted(aud))}; hold the lock (or "
+                    "pragma-exempt an intentionally atomic access with "
+                    "a reason)",
+                )

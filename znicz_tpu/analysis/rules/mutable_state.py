@@ -14,7 +14,7 @@ from __future__ import annotations
 import ast
 from typing import Dict
 
-from znicz_tpu.analysis.context import _param_names
+from znicz_tpu.analysis.context import scope_local_names
 from znicz_tpu.analysis.rules import Rule, register
 
 _MUTABLE_LITERALS = (
@@ -34,26 +34,6 @@ def _is_mutable_expr(node: ast.AST) -> bool:
     if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
         return node.func.id in _MUTABLE_CALLS
     return False
-
-
-def _scope_local_names(fn) -> set:
-    """Parameters plus every name the function itself binds — python
-    scoping makes such a name local THROUGHOUT the function, so a load
-    of it can never capture the module-level variable."""
-    names = set(_param_names(fn))
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        node = stack.pop()
-        if isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-        ):
-            continue  # nested scopes bind their own names
-        if isinstance(node, ast.Name) and isinstance(
-            node.ctx, (ast.Store, ast.Del)
-        ):
-            names.add(node.id)
-        stack.extend(ast.iter_child_nodes(node))
-    return names
 
 
 @register
@@ -109,7 +89,7 @@ class MutableStateRule(Rule):
                 fn = info.enclosing_function(node)
                 local_names = set()
                 while fn is not None:
-                    local_names |= _scope_local_names(fn)
+                    local_names |= scope_local_names(fn)
                     fn = info.enclosing_function(fn)
                 if node.id in local_names:
                     continue  # shadowed by a parameter or local binding

@@ -1,0 +1,269 @@
+"""ZNC013: background threads whose death is not a typed event.
+
+The PR 6 serving contract (docs/SERVING.md): **a thread death must be
+a typed event** — the front door's engine thread converts crashes into
+typed ``error`` completions plus a rebuild, the registry's heartbeat
+loop logs and keeps sweeping.  A ``threading.Thread(target=...)``
+whose target body can raise OUTSIDE a try/except that handles the
+exception dies with nothing but the interpreter's default stderr
+traceback: the watchdog never fires, the queue quietly stops draining,
+and the first symptom is a hung client.
+
+Scope: ``services/``, ``cluster/`` and ``observability/`` modules.
+For every ``threading.Thread(...)`` call whose ``target=`` resolves
+statically — ``self._loop`` (a method of the enclosing class), a
+module-level or local ``def``, a ``lambda``, or a
+``partial(fn, ...)`` of one — the rule scans the target body for a
+call (or ``raise``) that is not protected by a ``try`` whose handler
+catches broadly (``Exception`` / ``BaseException`` / bare) AND does
+something with it (contains at least one call — ``logger.exception``,
+a typed-event hook like ``self._engine_failure(exc)``; a silent
+``pass`` handler protects nothing, and ZNC008 flags it separately).
+
+Benign waits are whitelisted so the canonical loop shape stays quiet::
+
+    while not self._stop.wait(timeout=self.interval_s):   # safe
+        try:
+            self._sweep()                                  # guarded
+        except Exception:
+            logger.warning("sweep failed", exc_info=True)
+
+A target that genuinely cannot raise (every callee guards internally)
+is exempted at the ``Thread(...)`` line with
+``# znicz-check: disable=ZNC013 -- <reason>``.  Targets the analyzer
+cannot resolve (an imported callable, another object's method) are
+skipped, not guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from znicz_tpu.analysis.rules import Rule, register
+
+# Event/Condition/loop plumbing that does not raise in practice
+_SAFE_ATTR_CALLS = {"wait", "is_set", "set", "clear", "is_alive"}
+# logging methods (logger.warning(...), logging.exception(...))
+_LOG_METHODS = {
+    "debug",
+    "info",
+    "warning",
+    "error",
+    "exception",
+    "critical",
+    "log",
+}
+_SAFE_RESOLVED = {
+    "time.sleep",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.time",
+    "len",
+    "int",
+    "float",
+    "str",
+    "bool",
+    "round",
+    "min",
+    "max",
+}
+_BROAD_EXCEPTIONS = {"Exception", "BaseException"}
+
+
+def _handler_is_broad_and_typed(handler: ast.ExceptHandler) -> bool:
+    """A handler that catches everything and DOES something: logging,
+    a typed-event callback — anything but swallowing silently."""
+    if handler.type is not None:
+        t = handler.type
+        tails: List[str] = []
+        for e in t.elts if isinstance(t, ast.Tuple) else [t]:
+            if isinstance(e, ast.Attribute):
+                tails.append(e.attr)
+            elif isinstance(e, ast.Name):
+                tails.append(e.id)
+        if not any(name in _BROAD_EXCEPTIONS for name in tails):
+            return False
+    # the handler must DO something that isn't itself a (re-)raise: a
+    # `raise RuntimeError(exc)` handler still kills the thread, so its
+    # exception-constructor call does not make it a sink
+    in_raise = set()
+    for r in ast.walk(handler):
+        if isinstance(r, ast.Raise):
+            in_raise.update(id(n) for n in ast.walk(r))
+    return any(
+        isinstance(n, ast.Call) and id(n) not in in_raise
+        for n in ast.walk(handler)
+    )
+
+
+class _BodyScan:
+    """Find the first call/raise a thread target can die on."""
+
+    def __init__(self, info):
+        self.info = info
+        self.first: Optional[Tuple[int, str]] = None
+
+    def _risky_call(self, call: ast.Call) -> Optional[str]:
+        resolved = self.info.resolved(call.func)
+        if resolved in _SAFE_RESOLVED:
+            return None
+        if resolved is not None and resolved.split(".")[0] == "logging":
+            return None
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _SAFE_ATTR_CALLS | _LOG_METHODS:
+                return None
+            return f"self-or-object call '.{call.func.attr}()'"
+        if resolved is not None:
+            return f"call '{resolved}()'"
+        return "call"
+
+    def _note(self, node: ast.AST, what: str) -> None:
+        if self.first is None:
+            self.first = (getattr(node, "lineno", 0), what)
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                what = self._risky_call(n)
+                if what is not None:
+                    self._note(n, what)
+
+    def scan(self, stmts: List[ast.stmt], protected: bool) -> None:
+        for s in stmts:
+            if isinstance(
+                s,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue  # nested defs run elsewhere
+            if isinstance(s, ast.Try):
+                covers = protected or any(
+                    _handler_is_broad_and_typed(h) for h in s.handlers
+                )
+                self.scan(s.body, covers)
+                for h in s.handlers:
+                    # a broad, non-silent handler IS the typed-event
+                    # sink — the rule does not demand infinite regress
+                    # into what the sink itself calls
+                    self.scan(
+                        h.body,
+                        protected or _handler_is_broad_and_typed(h),
+                    )
+                self.scan(s.orelse, covers)
+                self.scan(s.finalbody, protected)
+                continue
+            if isinstance(s, (ast.While, ast.If)):
+                if not protected:
+                    self._scan_expr(s.test)
+                self.scan(s.body, protected)
+                self.scan(s.orelse, protected)
+                continue
+            if isinstance(s, (ast.For, ast.AsyncFor)):
+                if not protected:
+                    self._scan_expr(s.iter)
+                self.scan(s.body, protected)
+                self.scan(s.orelse, protected)
+                continue
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                if not protected:
+                    for item in s.items:
+                        self._scan_expr(item.context_expr)
+                self.scan(s.body, protected)
+                continue
+            if protected:
+                continue
+            if isinstance(s, ast.Raise):
+                self._note(s, "raise")
+                continue
+            self._scan_expr(s)
+
+
+@register
+class ThreadExceptionSinkRule(Rule):
+    id = "ZNC013"
+    severity = "warning"
+    title = (
+        "background-thread target can raise outside a handled "
+        "try/except (a thread death must be a typed event)"
+    )
+
+    _SCOPES = ("/services/", "/cluster/", "/observability/")
+
+    def _in_scope(self, info) -> bool:
+        path = f"/{info.path}".replace("\\", "/")
+        return any(scope in path for scope in self._SCOPES)
+
+    def _resolve_target(self, info, thread_call: ast.Call, expr):
+        """The target's FunctionDef/Lambda, or None when not statically
+        resolvable.  Handles ``partial(fn, ...)``."""
+        if (
+            isinstance(expr, ast.Call)
+            and (info.resolved(expr.func) or "").rpartition(".")[2]
+            == "partial"
+            and expr.args
+        ):
+            expr = expr.args[0]
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            cur = info.parents.get(thread_call)
+            while cur is not None and not isinstance(cur, ast.ClassDef):
+                cur = info.parents.get(cur)
+            if cur is None:
+                return None
+            for node in cur.body:
+                if (
+                    isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                    and node.name == expr.attr
+                ):
+                    return node
+            return None
+        if isinstance(expr, ast.Name):
+            for fn, _bound in info.traced._resolve_local(
+                expr, thread_call
+            ):
+                return fn
+        return None
+
+    def check(self, info) -> Iterable:
+        if not self._in_scope(info):
+            return
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if info.resolved(node.func) != "threading.Thread":
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None:
+                continue
+            fn = self._resolve_target(info, node, target)
+            if fn is None:
+                continue
+            scan = _BodyScan(info)
+            if isinstance(fn, ast.Lambda):
+                scan._scan_expr(fn.body)
+                name = "<lambda>"
+            else:
+                scan.scan(fn.body, protected=False)
+                name = fn.name
+            if scan.first is None:
+                continue
+            line, what = scan.first
+            yield self.finding(
+                info,
+                node,
+                f"thread target '{name}' can die on an unhandled "
+                f"exception ({what} at line {line} runs outside a "
+                "try/except that catches Exception and handles it); "
+                "wrap the risky work so a thread death becomes a "
+                "logged/typed event, or pragma-exempt with a reason",
+            )

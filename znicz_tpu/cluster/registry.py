@@ -245,7 +245,15 @@ class ReplicaRegistry:
         lock = threading.Lock()
 
         def one(rep: Replica) -> None:
-            state = self._apply(rep, *self._probe_http(rep))
+            try:
+                state = self._apply(rep, *self._probe_http(rep))
+            except Exception:  # ZNC013: a probe-thread death must log
+                logger.warning(
+                    "probe of %s failed unexpectedly", rep.instance,
+                    exc_info=True,
+                )
+                return  # the sweep's join is bounded; the entry keeps
+                # its previous state until the next probe lands
             with lock:
                 results[rep.instance] = state
 

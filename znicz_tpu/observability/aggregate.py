@@ -699,7 +699,9 @@ class MetricsPusher:
         if self._thread is not None and self._thread.is_alive():
             return self
         self._stop.clear()
-        self._thread = threading.Thread(
+        # _loop only calls push_now, whose contract is "never raises"
+        # (every failure is caught, counted and logged inside it)
+        self._thread = threading.Thread(  # znicz-check: disable=ZNC013
             target=self._loop,
             name=f"znicz-pusher-{self.instance}",
             daemon=True,

@@ -492,7 +492,8 @@ class ServingFrontDoor:
             logger.error(
                 "front door engine thread failed to stop (stalled tick?)"
             )
-        self._closed = True
+        with self._lock:  # submit() reads _closed under the lock
+            self._closed = True
         if self._pusher is not None:
             # final flush AFTER the drain: the aggregator's last view of
             # this instance includes the shutdown-path counters
@@ -584,10 +585,12 @@ class ServingFrontDoor:
         """Front-door report: the admission/termination tallies plus
         the live engine's own :meth:`~DecodeEngine.stats`."""
         eng = self._engine
+        with self._lock:  # _reject mutates the dict under the lock
+            rejected = dict(self._n_rejected)
         return {
             "submitted": self._n_submitted,
             "completed": self._n_completed,
-            "rejected": dict(self._n_rejected),
+            "rejected": rejected,
             "cancelled": self._n_cancelled,
             "deadline_exceeded": self._n_deadline,
             "shed": self._n_shed,
@@ -599,20 +602,25 @@ class ServingFrontDoor:
     # -- the engine thread ------------------------------------------------
 
     def _serve_loop(self) -> None:
+        # the WHOLE body runs under the failure handler (ZNC013): a
+        # crash anywhere on this thread — has_work touching a dying
+        # engine included, not just the tick itself — must become the
+        # watchdog's typed restart path, never a silent thread death
         while True:
-            if not self.has_work():
-                self._wake.wait(timeout=self.idle_tick_s)
-            self._wake.clear()
-            stopping = self._stop.is_set()
-            if stopping:
-                self._shed_requested = True
             try:
+                if not self.has_work():
+                    self._wake.wait(timeout=self.idle_tick_s)
+                self._wake.clear()
+                stopping = self._stop.is_set()
+                if stopping:
+                    self._shed_requested = True
                 self._tick()
+                if stopping and not self.has_work():
+                    break
             except Exception as exc:  # engine-thread failure
                 self._engine_failure(exc)
-            if stopping and not self.has_work():
-                break
-        self._closed = True
+        with self._lock:
+            self._closed = True
 
     def _tick(self) -> None:
         self._tick_started = time.monotonic()
@@ -640,13 +648,16 @@ class ServingFrontDoor:
         ticks (so engine state is only ever touched from this thread)."""
         with self._lock:
             cancels, self._cancels = self._cancels, set()
+            # snapshot under the lock: submit() appends concurrently,
+            # and iterating a deque mid-append raises (ZNC012)
+            pending = list(self._pending)
         eng = self._engine
         for tid in cancels:
             fr = self._by_id.get(tid)
             if fr is None:
                 continue  # completed before the cancel landed
             self._terminate(fr, REASON_CANCELLED, eng)
-        for fr in [f for f in list(self._pending) if self._expired(f)]:
+        for fr in [f for f in pending if self._expired(f)]:
             self._terminate(fr, REASON_DEADLINE, eng)
         for fr in [
             f for f in list(self._inflight.values()) if self._expired(f)
@@ -977,7 +988,8 @@ class ServingFrontDoor:
         self._m_inflight.set(len(self._inflight))
         frac = getattr(eng, "pool_free_frac", None)
         if frac is not None:
-            self._pool_free_frac = frac
+            with self._lock:  # submit()'s shed check reads it locked
+                self._pool_free_frac = frac
 
     def _reject(self, reason: str) -> None:
         """Tally one shed submission (lock held by the caller)."""

@@ -399,20 +399,27 @@ def shutdown_gracefully(server, frontdoor=None, grace_s: float = 5.0):
     response threads are daemonic (``ThreadingHTTPServer``), and every
     front-door stream has already been resolved by ``close()`` — so
     shutdown cannot hang on a slow client."""
-    if frontdoor is not None:
-        frontdoor.close(drain=True, grace_s=grace_s)
-    # a recording tracer is flushed and closed AFTER the drain, so the
-    # spans of the final requests land in the JSONL file before exit —
-    # a SIGTERM rollout must not truncate the trace (ISSUE 7 satellite)
     try:
+        if frontdoor is not None:
+            frontdoor.close(drain=True, grace_s=grace_s)
+        # a recording tracer is flushed and closed AFTER the drain, so
+        # the spans of the final requests land in the JSONL file before
+        # exit — a SIGTERM rollout must not truncate the trace (ISSUE 7
+        # satellite)
         from znicz_tpu.observability import get_tracer
 
         tracer = get_tracer()
         if tracer.recording:
             tracer.stop()
     except Exception:
-        logger.warning("tracer flush on shutdown failed", exc_info=True)
-    server.shutdown()
+        # ZNC013: this runs on the signal handler's shutdown thread —
+        # a failed drain must still reach server.shutdown(), or SIGTERM
+        # leaves the listener serving forever
+        logger.exception("graceful drain failed; stopping the listener")
+    try:
+        server.shutdown()
+    except Exception:
+        logger.exception("listener shutdown failed")
 
 
 def run_server(server, frontdoor=None, grace_s: float = 5.0) -> int:
