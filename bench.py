@@ -58,17 +58,49 @@ def _metrics_snapshot() -> dict:
     (``{"type": "slo", ...}`` — self-describing next to the metric
     families) carries the lifetime SLO judgment over the same registry:
     per-target percentiles, burn rates and the breach flag that
-    ``tools/znicz-slo`` gates on."""
+    ``tools/znicz-slo`` gates on.  A ``"programs"`` entry (same
+    self-describing shape) carries the device/compile ledger headline —
+    every round records how many programs the run compiled, their total
+    compile wall seconds and the per-kind split, so a compile-count
+    regression is diffable round-over-round via znicz-bench-diff."""
     try:
-        from znicz_tpu.observability import get_registry
+        from znicz_tpu.observability import device, get_registry
         from znicz_tpu.observability import slo as slo_mod
 
         snap = get_registry().snapshot()
         snap["slo"] = slo_mod.lifetime_snapshot()
+        ledger = device.ledger_snapshot()
+        snap["programs"] = {
+            "type": "programs",
+            "count": ledger["count"],
+            "engine_count": ledger["engine_count"],
+            "by_kind": ledger["by_kind"],
+            "compile_seconds_total": ledger["compile_seconds_total"],
+        }
         return snap
     except Exception as e:
         # the record must still print even if telemetry import breaks
         print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def _program_headline() -> dict:
+    """Top-level numeric compile-ledger fields for the summary record
+    (``programs_compiled`` is lower-better under znicz-bench-diff's
+    name heuristic — a compile-count regression across rounds fails
+    the gate)."""
+    try:
+        from znicz_tpu.observability import device
+
+        # the two scalars only — ledger_snapshot() would copy every
+        # entry and poll per-device memory_stats a second time per
+        # record (metrics_snapshot already does that once)
+        return {
+            "programs_compiled": device.program_count(),
+            "programs_compile_seconds": device.compile_seconds_total(),
+        }
+    except Exception as e:
+        print(f"program headline failed: {e!r}", file=sys.stderr)
         return {}
 
 
@@ -1773,12 +1805,17 @@ def main() -> None:
         raise SystemExit(1)
     failed = run_sections(only=only)
     # full telemetry registry behind this run's numbers: phase
-    # histograms, serve counters/latency, cache stats
+    # histograms, serve counters/latency, cache stats.  The compile
+    # ledger's headline rides as TOP-LEVEL numeric fields — the
+    # driver's "parsed" merge (and znicz-bench-diff's record flatten)
+    # only lift top-level numbers, so nesting them under
+    # metrics_snapshot would make the compile-count gate inert
     emit(
         {
             "metric": "bench_sections_failed",
             "value": len(failed),
             "failed_sections": failed,
+            **_program_headline(),
             "metrics_snapshot": _metrics_snapshot(),
         }
     )

@@ -1,4 +1,5 @@
-"""bench.py section harness: schema, isolation, selection, retry.
+"""bench.py section harness: schema, isolation, selection, retry —
+plus the znicz-bench-diff regression gate over round files.
 
 Tier-1 (no TPU): the bench driver parses ONE JSON object per line, so
 the section runner must emit exactly that — a ``{"metric": ...}``
@@ -6,6 +7,10 @@ record per succeeding section and an ``{"error": ..., "section": ...}``
 record for a failing one, with every OTHER section's records intact
 (BENCH_r05 lost a whole round to one init flake).  Sections here are
 monkeypatched fast fakes; the real measurement bodies never run.
+
+``znicz-bench-diff`` (the bench trajectory's machine-readable gate)
+is smoke-tested here in the same tier so a schema drift in either the
+round files or the tool fails CI, not the next release round.
 """
 
 import json
@@ -13,6 +18,7 @@ import json
 import pytest
 
 import bench
+from znicz_tpu.utils import bench_diff
 
 
 def _collect(sections, only=None, budget_s=0):
@@ -130,6 +136,187 @@ class TestSectionBudget:
         )
         assert failed == []
         assert json.loads(lines[0])["metric"] == "quick_rate"
+
+
+def _round_file(tmp_path, name, metrics, driver=True):
+    """One bench round on disk, in either accepted shape."""
+    path = tmp_path / name
+    if driver:
+        path.write_text(json.dumps({"rc": 0, "parsed": metrics}))
+    else:
+        lines = [
+            json.dumps({"metric": k, "value": v, "unit": "u"})
+            for k, v in metrics.items()
+        ]
+        path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+class TestBenchDiff:
+    def test_clean_diff_exits_zero(self, tmp_path, capsys):
+        old = _round_file(
+            tmp_path, "old.json", {"lm_serve_tokens_per_sec": 100.0}
+        )
+        new = _round_file(
+            tmp_path, "new.json", {"lm_serve_tokens_per_sec": 99.0}
+        )
+        assert bench_diff.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "0 regression(s)" in out
+
+    def test_throughput_drop_is_a_regression(self, tmp_path):
+        old = _round_file(
+            tmp_path, "old.json", {"lm_serve_tokens_per_sec": 100.0}
+        )
+        new = _round_file(
+            tmp_path, "new.json", {"lm_serve_tokens_per_sec": 80.0}
+        )
+        assert bench_diff.main([old, new, "--threshold", "0.1"]) == 1
+        # a looser threshold tolerates the same move
+        assert bench_diff.main([old, new, "--threshold", "0.25"]) == 0
+
+    def test_latency_shaped_metrics_regress_upward(self, tmp_path):
+        old = _round_file(
+            tmp_path, "old.json",
+            {"lm_serve_frontdoor_ttft_p99_ms": 10.0, "step_ms": 5.0},
+        )
+        new = _round_file(
+            tmp_path, "new.json",
+            {"lm_serve_frontdoor_ttft_p99_ms": 15.0, "step_ms": 5.1},
+        )
+        # ttft +50% regresses; step_ms +2% is inside the threshold
+        assert bench_diff.main([old, new]) == 1
+        assert bench_diff.main(
+            [old, new, "--only", "step_ms"]
+        ) == 0
+
+    def test_lower_better_from_zero_regresses(self, tmp_path):
+        old = _round_file(
+            tmp_path, "old.json", {"lm_serve_paged_compiles": 0.0}
+        )
+        new = _round_file(
+            tmp_path, "new.json", {"lm_serve_paged_compiles": 2.0}
+        )
+        assert bench_diff.main([old, new]) == 1
+
+    def test_ndjson_rounds_and_missing_metrics_tolerated(
+        self, tmp_path, capsys
+    ):
+        old = _round_file(
+            tmp_path, "old.json",
+            {"a_rate_per_sec": 1.0, "only_old_per_sec": 3.0},
+            driver=False,
+        )
+        new = _round_file(
+            tmp_path, "new.json",
+            {"a_rate_per_sec": 1.05, "only_new_per_sec": 9.0},
+            driver=False,
+        )
+        assert bench_diff.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "present in only one round" in out
+
+    def test_error_records_skipped_in_ndjson(self, tmp_path):
+        path = tmp_path / "mixed.json"
+        path.write_text(
+            json.dumps({"metric": "x_per_sec", "value": 2.0}) + "\n"
+            + json.dumps({"error": "RuntimeError", "section": "s"})
+            + "\n"
+        )
+        assert bench_diff.load_metrics(str(path)) == {"x_per_sec": 2.0}
+
+    def test_direction_overrides(self, tmp_path):
+        old = _round_file(tmp_path, "old.json", {"oddly_named": 10.0})
+        new = _round_file(tmp_path, "new.json", {"oddly_named": 20.0})
+        # default: higher-better, a rise is fine
+        assert bench_diff.main([old, new]) == 0
+        assert bench_diff.main(
+            [old, new, "--lower", "oddly_named"]
+        ) == 1
+
+    def test_json_output_shape(self, tmp_path, capsys):
+        old = _round_file(tmp_path, "old.json", {"r_per_sec": 1.0})
+        new = _round_file(tmp_path, "new.json", {"r_per_sec": 0.5})
+        assert bench_diff.main([old, new, "--json"]) == 1
+        body = json.loads(capsys.readouterr().out)
+        assert body["regressions"] == 1
+        assert body["rows"][0]["metric"] == "r_per_sec"
+        assert body["rows"][0]["regressed"] is True
+
+    def test_usage_and_parse_errors_exit_two(self, tmp_path, capsys):
+        assert bench_diff.main([]) == 2
+        assert bench_diff.main(["one.json"]) == 2
+        assert bench_diff.main(["a", "b", "--threshold"]) == 2
+        bad = tmp_path / "bad.json"
+        bad.write_text("not json at all")
+        ok = _round_file(tmp_path, "ok.json", {"m_per_sec": 1.0})
+        assert bench_diff.main([str(bad), ok]) == 2
+        capsys.readouterr()  # drain stderr/stdout
+
+    def test_fully_failed_round_fails_the_gate(self, tmp_path, capsys):
+        """A round that crashed entirely (driver rc!=0, no parsed
+        metrics — the committed BENCH_r05 shape) must NOT pass as
+        '0 compared, 0 regressions': the gate exits 2."""
+        failed = tmp_path / "failed.json"
+        failed.write_text(
+            json.dumps({"rc": 1, "cmd": "python bench.py",
+                        "tail": "Traceback ...", "parsed": None})
+        )
+        ok = _round_file(tmp_path, "ok.json", {"m_per_sec": 1.0})
+        assert bench_diff.main([ok, str(failed)]) == 2
+        assert "no numeric metrics" in capsys.readouterr().err
+        # all-error NDJSON is the same story
+        errs = tmp_path / "errs.json"
+        errs.write_text(
+            json.dumps({"error": "RuntimeError", "section": "s"}) + "\n"
+        )
+        assert bench_diff.main([ok, str(errs)]) == 2
+        capsys.readouterr()
+
+    def test_program_headline_is_top_level_and_diffable(self, tmp_path):
+        """The compile-ledger headline must ride as TOP-LEVEL numeric
+        fields of the summary record (nested under metrics_snapshot it
+        would be invisible to the flatten), and a compile-count rise
+        must regress under the name heuristic."""
+        headline = bench._program_headline()
+        assert set(headline) >= {
+            "programs_compiled", "programs_compile_seconds"
+        }
+        old = _round_file(
+            tmp_path, "old.json",
+            {"bench_sections_failed": 0, "programs_compiled": 3.0},
+        )
+        new = _round_file(
+            tmp_path, "new.json",
+            {"bench_sections_failed": 0, "programs_compiled": 5.0},
+        )
+        assert bench_diff.main([old, new]) == 1  # compiles grew: gate
+
+    def test_committed_round_files_still_load(self):
+        """The real BENCH_*.json trajectory must stay parseable — the
+        tool is only a gate if it can read the artifacts the driver
+        actually writes."""
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        rounds = sorted(
+            f for f in os.listdir(root)
+            if f.startswith("BENCH_r") and f.endswith(".json")
+        )
+        assert rounds, "no committed bench rounds found"
+        loaded = 0
+        for name in rounds:
+            try:
+                metrics = bench_diff.load_metrics(
+                    os.path.join(root, name)
+                )
+            except ValueError:
+                continue  # an all-error round carries no metrics
+            loaded += 1
+            assert all(
+                isinstance(v, float) for v in metrics.values()
+            )
+        assert loaded >= 2  # enough history for a real diff
 
 
 class TestBackendRetry:

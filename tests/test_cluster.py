@@ -497,6 +497,67 @@ class TestRouting:
         assert order == ["b", "a"]  # lighter replica first
         router.close()
 
+    def test_slo_burn_rate_demotes_in_the_tiebreak(self):
+        """The ROADMAP rung: per-replica /slo burn rates (exported as
+        the znicz_serve_slo_burn_rate gauge, pushed per instance) join
+        the load tiebreak — a replica burning its error budget ranks
+        behind every non-burning peer even when it is otherwise the
+        lightest."""
+        agg = MetricsAggregator()
+
+        def fam(pending, burn):
+            return {
+                "znicz_serve_frontdoor_pending": {
+                    "type": "gauge", "help": "",
+                    "series": [{"labels": {}, "value": pending}],
+                },
+                "znicz_serve_slo_burn_rate": {
+                    "type": "gauge", "help": "",
+                    "series": [{"labels": {}, "value": burn}],
+                },
+            }
+
+        # "a" is idle but BURNING; "b" is busier but healthy
+        agg.push("a", fam(pending=0.0, burn=2.5))
+        agg.push("b", fam(pending=6.0, burn=0.1))
+        reg = ReplicaRegistry(start=False)
+        router = ServingRouter(reg, block_size=BS, aggregator=agg)
+        reg.register("a", "http://127.0.0.1:1", probe=False)
+        reg.register("b", "http://127.0.0.1:2", probe=False)
+        order = [rep.instance for rep, _ in router.rank([])]
+        assert order == ["b", "a"]  # burn band beats queue depth
+        # ...and beats AFFINITY too: the burning replica holds the
+        # whole prefix, yet shared-prefix traffic must not keep
+        # landing on a breached replica (the band sorts above overlap,
+        # like the health band)
+        keys = [f"k{i:02d}" for i in range(4)]
+        router.affinity.learn("a", keys)
+        ranked = router.rank(keys)
+        assert [rep.instance for rep, _ in ranked] == ["b", "a"]
+        assert dict(
+            (rep.instance, ov) for rep, ov in ranked
+        )["a"] == 4  # the overlap was seen, the burn band overrode it
+        # under the breach threshold affinity rules again
+        router.slo_burn_threshold = 5.0
+        order = [rep.instance for rep, _ in router.rank(keys)]
+        assert order == ["a", "b"]
+        router.close()
+
+    def test_frontdoor_publishes_the_burn_gauge(self, fleet, params):
+        """The gauge the tiebreak consumes really is written by the
+        serving door on its SLO sample cadence."""
+        gen = np.random.default_rng(43)
+        r = fleet.post(gen.integers(0, 17, (5,)).astype(np.int32),
+                       max_new=4)
+        assert r["status"] == 200
+        fleet.doors[0]._publish_burn()  # engine-thread cadence, forced
+        gauge = obs.gauge(
+            "znicz_serve_slo_burn_rate",
+            "max SLO burn rate across targets and windows with data "
+            "(the router load tiebreak's per-instance input)",
+        )
+        assert gauge.value >= 0.0  # published, readable
+
 
 # -- failover ---------------------------------------------------------------
 

@@ -1,0 +1,747 @@
+"""Fleet tracing: one merged timeline from router hop to engine chunk.
+
+The collector contract (docs/OBSERVABILITY.md "Distributed tracing"):
+replicas and the router push bounded span batches to a TraceCollector
+(aggregator-shaped: instance-tagged, TTL-expired), and ``GET /trace``
+answers ONE Perfetto-loadable Chrome trace with pid=instance and every
+instance rebased onto a shared wall-clock epoch — so filtering a single
+client-visible trace id shows the request's whole life: the router's
+route/retry instants, BOTH replicas' queue/prefill/decode spans across
+a mid-stream failover, preemptions included.  Trace-context propagation
+makes the filter possible: the router mints the id, forwards it via
+``X-Znicz-Trace-Id``, and the replica adopts it instead of minting its
+own.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu import observability as obs
+from znicz_tpu.cluster import ServingRouter, build_router_server
+from znicz_tpu.core import prng
+from znicz_tpu.observability.collector import (
+    TraceCollector,
+    TracePusher,
+    build_collector_server,
+)
+from znicz_tpu.observability.tracing import Tracer
+from znicz_tpu.services import PagedDecodeEngine, ServingFrontDoor
+from znicz_tpu.services import serve as serve_mod
+from znicz_tpu.utils import faults
+from znicz_tpu.workflow.transformer import init_lm_params
+
+EOS = 14
+HEADS = 4
+T_MAX = 64
+BS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def params():
+    prng.seed_all(27)
+    return init_lm_params(17, 32, 2, HEADS, max_seq=T_MAX)
+
+
+def _engine_kwargs(**kw):
+    kw.setdefault("n_heads", HEADS)
+    kw.setdefault("eos_id", EOS)
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("max_seq", T_MAX)
+    kw.setdefault("admit_every", 4)
+    return kw
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm(params):
+    """Compile every program the fleet scenario runs BEFORE any traced
+    request, so the zero-new-compiled-programs pin below measures the
+    tracing layer, not a cold jit cache."""
+    eng = PagedDecodeEngine(params, **_engine_kwargs())
+    gen = np.random.default_rng(3)
+    eng.submit(gen.integers(0, 17, (21,)).astype(np.int32), 30)
+    eng.submit(gen.integers(0, 17, (5,)).astype(np.int32), 8)
+    eng.run()
+
+
+def _wait_until(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _ev(name, ts=0.0, ph="X", **args):
+    ev = {"name": name, "ph": ph, "ts": ts, "pid": 1, "tid": 1}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+# -- unit: the tracer's fleet hooks -----------------------------------------
+
+
+class TestTracerFleetHooks:
+    def test_default_instance_tag_stamped_explicit_wins(self):
+        t = Tracer()
+        t.start()
+        t.set_instance("rep-9")
+        with t.span("x"):
+            pass
+        t.instant("y", instance="other")
+        events = t.stop()
+        by_name = {e["name"]: e for e in events}
+        assert by_name["x"]["args"]["instance"] == "rep-9"
+        assert by_name["y"]["args"]["instance"] == "other"
+
+    def test_sink_receives_events_and_is_bounded(self):
+        t = Tracer()
+        t.start()
+        q = t.add_sink(maxlen=3)
+        for i in range(5):
+            t.instant("e", i=i)
+        assert len(q) == 3  # oldest dropped, bounded
+        assert [e["args"]["i"] for e in q] == [2, 3, 4]
+        t.remove_sink(q)
+        t.instant("after")
+        assert len(q) == 3  # detached: no longer fed
+        t.stop()
+
+    def test_ensure_recording_starts_once(self):
+        t = Tracer()
+        assert t.ensure_recording() is True
+        assert t.recording
+        assert t.ensure_recording() is False  # already on
+        t.stop()
+
+
+# -- unit: the collector ----------------------------------------------------
+
+
+class TestTraceCollector:
+    def test_merged_pids_metadata_and_instances(self):
+        col = TraceCollector()
+        col.push("rep-a", [_ev("serve/admit", 10.0)], now=0.0)
+        col.push("rep-b", [_ev("serve/admit", 20.0)], now=0.0)
+        merged = col.merged_trace(now=1.0)
+        meta = [e for e in merged["traceEvents"] if e["ph"] == "M"]
+        names = {e["args"]["name"]: e["pid"] for e in meta}
+        assert set(names) == {"rep-a", "rep-b"}
+        assert len(set(names.values())) == 2  # distinct pids
+        spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in spans} == set(names.values())
+        assert merged["instances"] == ["rep-a", "rep-b"]
+
+    def test_event_instance_tag_splits_one_envelope(self):
+        # an in-process fleet pushes through ONE pusher; the per-event
+        # instance args still split the merged view into tracks
+        col = TraceCollector()
+        col.push(
+            "proc",
+            [
+                _ev("a", instance="rep-0"),
+                _ev("b", instance="rep-1"),
+                _ev("c"),  # untagged: envelope instance
+            ],
+            now=0.0,
+        )
+        merged = col.merged_trace(now=0.5)
+        assert merged["instances"] == ["proc", "rep-0", "rep-1"]
+
+    def test_epoch_rebase_onto_shared_timeline(self):
+        col = TraceCollector()
+        col.push("a", [_ev("x", ts=5.0)], epoch_us=1_000_000.0, now=0.0)
+        col.push("b", [_ev("y", ts=5.0)], epoch_us=2_500_000.0, now=0.0)
+        spans = {
+            e["name"]: e
+            for e in col.merged_trace(now=0.5)["traceEvents"]
+            if e["ph"] == "X"
+        }
+        assert spans["x"]["ts"] == 5.0  # earliest epoch is the base
+        assert spans["y"]["ts"] == 1_500_005.0
+
+    def test_ttl_expiry_drops_instance(self):
+        col = TraceCollector()
+        col.push("short", [_ev("x")], ttl_s=1.0, now=0.0)
+        col.push("long", [_ev("y")], ttl_s=100.0, now=0.0)
+        assert len(col.instances(now=0.5)) == 2
+        inst = col.instances(now=5.0)
+        assert [i["instance"] for i in inst] == ["long"]
+        names = [
+            e["name"]
+            for e in col.merged_trace(now=5.0)["traceEvents"]
+            if e["ph"] == "X"
+        ]
+        assert names == ["y"]
+
+    def test_trace_id_filter_matches_all_arg_conventions(self):
+        col = TraceCollector()
+        col.push(
+            "a",
+            [
+                _ev("serve/queued", trace="T1"),
+                _ev("frontdoor/submit", id="T1"),
+                _ev("serve/decode", traces="T1,T2"),
+                _ev("serve/decode", traces="T21,T3"),  # no substring hit
+                _ev("other", trace="T9"),
+            ],
+            now=0.0,
+        )
+        got = [
+            e["name"]
+            for e in col.merged_trace("T1", now=0.5)["traceEvents"]
+            if e["ph"] != "M"
+        ]
+        assert got == [
+            "serve/queued", "frontdoor/submit", "serve/decode"
+        ]
+
+    def test_filter_keeps_collision_suffixed_ids(self):
+        """The front door adopts a duplicate inbound id as
+        ``<id>-r<n>``; filtering by the client's original id must
+        still surface that request's lifecycle (and not over-match
+        ids that merely share a prefix)."""
+        col = TraceCollector()
+        col.push(
+            "a",
+            [
+                _ev("serve/queued", trace="T1"),
+                _ev("serve/queued", trace="T1-r0003"),
+                _ev("serve/decode", traces="T1-r0003,Z9"),
+                _ev("serve/queued", trace="T12"),  # prefix, no -r
+                # a DIFFERENT client-chosen id sharing the "-r" prefix
+                # (only all-digit suffixes are the collision spelling)
+                _ev("serve/queued", trace="T1-run2"),
+            ],
+            now=0.0,
+        )
+        got = [
+            (e["name"], (e.get("args") or {}))
+            for e in col.merged_trace("T1", now=0.5)["traceEvents"]
+            if e["ph"] != "M"
+        ]
+        assert len(got) == 3
+        assert all(
+            args.get("trace") not in ("T12", "T1-run2")
+            for _, args in got
+        )
+
+    def test_instances_report_age_and_window_drops(self):
+        col = TraceCollector(max_events_per_instance=4)
+        col.push("a", [_ev("e", i) for i in range(6)], now=1.0)
+        row = col.instances(now=3.5)[0]
+        assert row["age_s"] == 2.5  # last-push age, the satellite pin
+        assert row["events"] == 4 and row["dropped"] == 2
+
+    def test_bad_pushes_raise_value_error(self):
+        col = TraceCollector()
+        with pytest.raises(ValueError):
+            col.push("", [_ev("x")])
+        with pytest.raises(ValueError):
+            col.push("a", {"not": "a list"})
+        with pytest.raises(ValueError):
+            col.push("a", [_ev("x"), "not-a-dict"])
+        with pytest.raises(ValueError):
+            col.push("a", [], ttl_s=0.0)
+        assert col.instances() == []  # nothing partially applied
+
+
+# -- the HTTP surface -------------------------------------------------------
+
+
+@pytest.fixture
+def collector_srv():
+    srv = build_collector_server(port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _http(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(
+            method, path,
+            body=json.dumps(body) if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+class TestCollectorHTTP:
+    def test_push_trace_instances_healthz(self, collector_srv):
+        port = collector_srv.server_address[1]
+        status, body = _http(
+            port, "POST", "/push",
+            {"instance": "i1", "events": [_ev("x", trace="T")],
+             "epoch_us": 0.0},
+        )
+        assert status == 200 and body["accepted"] == 1
+        status, merged = _http(port, "GET", "/trace")
+        assert status == 200
+        assert any(
+            e["name"] == "x" for e in merged["traceEvents"]
+        )
+        status, merged = _http(port, "GET", "/trace?trace_id=T")
+        assert [
+            e["name"] for e in merged["traceEvents"] if e["ph"] != "M"
+        ] == ["x"]
+        status, inst = _http(port, "GET", "/instances")
+        assert status == 200 and inst["live"] == 1
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=10
+        )
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 200
+        conn.close()
+
+    def test_bad_push_400_unknown_404(self, collector_srv):
+        port = collector_srv.server_address[1]
+        status, body = _http(port, "POST", "/push", {"events": []})
+        assert status == 400 and body["error"] == "bad_push"
+        status, _ = _http(
+            port, "POST", "/push", {"instance": "i", "events": "nope"}
+        )
+        assert status == 400
+        status, _ = _http(port, "GET", "/nope")
+        assert status == 404
+        status, _ = _http(port, "POST", "/nope", {})
+        assert status == 404
+
+
+# -- the pusher -------------------------------------------------------------
+
+
+class TestTracePusher:
+    def test_end_to_end_push_and_final_flush(self, collector_srv):
+        port = collector_srv.server_address[1]
+        t = Tracer()
+        t.start()
+        t.set_instance("push-1")
+        pusher = TracePusher(
+            f"http://127.0.0.1:{port}", instance="push-1", tracer=t,
+            interval_s=30.0,  # the test drives pushes itself
+        )
+        with t.span("serve/admit", trace="T7"):
+            pass
+        assert pusher.push_now() is True
+        merged = collector_srv.collector.merged_trace()
+        assert any(
+            e["name"] == "serve/admit" for e in merged["traceEvents"]
+        )
+        # events queued after the last manual push flush on stop()
+        pusher.start()
+        t.instant("late", trace="T7")
+        pusher.stop()
+        assert any(
+            e["name"] == "late"
+            for e in collector_srv.collector.merged_trace()["traceEvents"]
+        )
+        assert q_detached(t, pusher)
+        t.stop()
+
+    def test_never_raises_dead_collector_and_fault(self):
+        t = Tracer()
+        t.start()
+        pusher = TracePusher(
+            "http://127.0.0.1:9", instance="p", tracer=t,  # dead port
+        )
+        t.instant("x")
+        assert pusher.push_now() is False
+        assert pusher.pushes_failed == 1
+        faults.inject("trace_pusher.push")
+        pusher2 = TracePusher(
+            "http://127.0.0.1:9", instance="p2", tracer=t
+        )
+        assert pusher2.push_now() is False  # fault path, still no raise
+        t.stop()
+
+    def test_bad_url_rejected(self):
+        with pytest.raises(ValueError):
+            TracePusher("ftp://nope")
+
+
+def q_detached(tracer, pusher) -> bool:
+    with tracer._lock:
+        return pusher._queue not in tracer._sinks
+
+
+class TestSharedPusher:
+    def test_attachments_share_one_pusher_no_duplicate_spans(
+        self, collector_srv
+    ):
+        """An in-process colocation (two doors + a router on one
+        tracer) must NOT push every span once per component — attach
+        returns the same pusher, and the last detach stops it."""
+        from znicz_tpu.observability.collector import (
+            attach_pusher,
+            detach_pusher,
+        )
+
+        url = f"http://127.0.0.1:{collector_srv.server_address[1]}"
+        t = Tracer()
+        t.start()
+        p1 = attach_pusher(url, instance="rep-0", tracer=t,
+                           interval_s=30.0)
+        p2 = attach_pusher(url, instance="rep-1", tracer=t)
+        try:
+            assert p1 is p2  # shared, not a second sink
+            t.instant("once", trace="S1")
+            p1.push_now()
+            merged = collector_srv.collector.merged_trace("S1")
+            spans = [
+                e for e in merged["traceEvents"] if e["ph"] != "M"
+            ]
+            assert len(spans) == 1  # ONE copy, not one per attachment
+            detach_pusher(p1)
+            assert not q_detached(t, p1)  # rep-1 still attached
+        finally:
+            detach_pusher(p2)
+        assert q_detached(t, p1)  # last detach stopped + unhooked
+        t.stop()
+
+    def test_later_attachment_tightens_the_cadence(self, collector_srv):
+        from znicz_tpu.observability.collector import (
+            attach_pusher,
+            detach_pusher,
+        )
+
+        url = f"http://127.0.0.1:{collector_srv.server_address[1]}"
+        t = Tracer()
+        t.start()
+        p1 = attach_pusher(url, tracer=t, interval_s=2.0)
+        ttl0 = p1.ttl_s
+        p2 = attach_pusher(url, tracer=t, interval_s=0.25)
+        try:
+            # the shared pusher runs at the FASTEST requested cadence
+            assert p1 is p2 and p1.interval_s == 0.25
+            assert p1.ttl_s == pytest.approx(ttl0 * 0.25 / 2.0)
+            # a slower later attachment does not loosen it back
+            p3 = attach_pusher(url, tracer=t, interval_s=5.0)
+            assert p3.interval_s == 0.25
+            detach_pusher(p3)
+        finally:
+            detach_pusher(p1)
+            detach_pusher(p2)
+            t.stop()
+
+    def test_doors_sharing_a_collector_share_the_pusher(
+        self, params, collector_srv
+    ):
+        url = f"http://127.0.0.1:{collector_srv.server_address[1]}"
+        doors = [
+            ServingFrontDoor(
+                lambda: PagedDecodeEngine(params, **_engine_kwargs()),
+                max_pending=4,
+                instance=f"share-{i}",
+                collector_url=url,
+            )
+            for i in range(2)
+        ]
+        try:
+            assert doors[0]._trace_pusher is doors[1]._trace_pusher
+        finally:
+            for door in doors:
+                door.close(grace_s=10.0)
+        tracer = obs.get_tracer()
+        if tracer.recording:
+            tracer.stop()
+
+    def test_bad_collector_url_fails_fast_without_leaking(self, params):
+        """A malformed collector_url must abort the constructor with
+        no background pusher thread left behind (the metrics pusher
+        was previously started first and leaked)."""
+        before = {
+            th.name
+            for th in threading.enumerate()
+            if th.name.startswith("znicz-pusher")
+            or th.name.startswith("znicz-trace-pusher")
+        }
+        with pytest.raises(ValueError):
+            ServingFrontDoor(
+                lambda: PagedDecodeEngine(params, **_engine_kwargs()),
+                max_pending=4,
+                instance="leaky",
+                aggregator_url="http://127.0.0.1:9",
+                collector_url="not-a-url",
+            )
+        after = {
+            th.name
+            for th in threading.enumerate()
+            if th.name.startswith("znicz-pusher")
+            or th.name.startswith("znicz-trace-pusher")
+        }
+        assert after == before
+        tracer = obs.get_tracer()
+        if tracer.recording:  # ensure_recording ran before the raise
+            tracer.stop()
+
+
+# -- trace-context propagation over HTTP ------------------------------------
+
+
+class TestTraceIdPropagation:
+    @pytest.fixture
+    def replica(self, params):
+        door = ServingFrontDoor(
+            lambda: PagedDecodeEngine(params, **_engine_kwargs()),
+            max_pending=8,
+            instance="rep-solo",
+        )
+        srv = serve_mod.build_server(directory=".", port=0, frontdoor=door)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield door, srv
+        srv.shutdown()
+        srv.server_close()
+        door.close(grace_s=10.0)
+
+    def _post(self, port, prompt, trace_id=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            headers = {"Content-Type": "application/json"}
+            if trace_id:
+                headers["X-Znicz-Trace-Id"] = trace_id
+            conn.request(
+                "POST", "/generate",
+                body=json.dumps(
+                    {"prompt": [int(x) for x in prompt],
+                     "max_new_tokens": 6}
+                ),
+                headers=headers,
+            )
+            resp = conn.getresponse()
+            out = {
+                "status": resp.status,
+                "trace_header": resp.getheader("X-Znicz-Trace-Id"),
+                "done": None,
+            }
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                rec = json.loads(line)
+                if rec.get("done"):
+                    out["done"] = rec
+            return out
+        finally:
+            conn.close()
+
+    def test_inbound_header_becomes_the_request_id(self, replica):
+        door, srv = replica
+        gen = np.random.default_rng(5)
+        prompt = gen.integers(0, 17, (9,)).astype(np.int32)
+        r = self._post(
+            srv.server_address[1], prompt, trace_id="client-abc-001"
+        )
+        assert r["status"] == 200
+        assert r["trace_header"] == "client-abc-001"
+        assert r["done"]["trace_id"] == "client-abc-001"
+
+    def test_without_header_the_door_mints(self, replica):
+        door, srv = replica
+        gen = np.random.default_rng(6)
+        prompt = gen.integers(0, 17, (5,)).astype(np.int32)
+        r = self._post(srv.server_address[1], prompt)
+        assert r["status"] == 200
+        assert r["trace_header"].startswith("znicz-")
+
+    def test_live_collision_keeps_the_id_as_prefix(self, replica):
+        door, _ = replica
+        with door._lock:
+            door._by_id["dup-1"] = object()  # membership is all it reads
+            tid = door._mint_id("dup-1")
+        with door._lock:
+            door._by_id.pop("dup-1")
+        assert tid.startswith("dup-1-r")
+
+
+# -- the acceptance scenario ------------------------------------------------
+
+
+class _TracedFleet:
+    """Two named replicas behind a router, spans flowing to a real
+    collector through ONE pusher on the process tracer (the in-process
+    twin of per-process pushers; per-event instance tags split the
+    merged view)."""
+
+    def __init__(self, params):
+        self.doors, self.srvs = [], []
+        for i in range(2):
+            door = ServingFrontDoor(
+                lambda: PagedDecodeEngine(params, **_engine_kwargs()),
+                max_pending=8,
+                instance=f"rep-{i}",
+            )
+            srv = serve_mod.build_server(
+                directory=".", port=0, frontdoor=door
+            )
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            self.doors.append(door)
+            self.srvs.append(srv)
+        self.router = ServingRouter(
+            block_size=BS, heartbeat_interval_s=60.0
+        )
+        for i, srv in enumerate(self.srvs):
+            self.router.register(
+                f"rep-{i}",
+                f"http://127.0.0.1:{srv.server_address[1]}",
+            )
+        self.rsrv = build_router_server(self.router, port=0)
+        threading.Thread(
+            target=self.rsrv.serve_forever, daemon=True
+        ).start()
+        self.port = self.rsrv.server_address[1]
+
+    def post(self, prompt, max_new=12):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", self.port, timeout=60
+        )
+        try:
+            conn.request(
+                "POST", "/generate",
+                body=json.dumps(
+                    {"prompt": [int(t) for t in prompt],
+                     "max_new_tokens": max_new}
+                ),
+            )
+            resp = conn.getresponse()
+            out = {
+                "status": resp.status,
+                "trace_header": resp.getheader("X-Znicz-Trace-Id"),
+                "tokens": [],
+                "done": None,
+            }
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                rec = json.loads(line)
+                if "token" in rec:
+                    out["tokens"].append(rec["token"])
+                elif rec.get("done"):
+                    out["done"] = rec
+            return out
+        finally:
+            conn.close()
+
+    def close(self):
+        for srv in self.srvs:
+            srv.shutdown()
+            srv.server_close()
+        self.rsrv.shutdown()
+        self.rsrv.server_close()
+        for door in self.doors:
+            door.close(grace_s=10.0)
+        self.router.close()
+
+
+class TestMergedFleetTimeline:
+    def test_one_trace_id_shows_the_full_cross_replica_life(
+        self, params, collector_srv
+    ):
+        """THE acceptance scenario: a request replayed through the
+        cluster proxy with an injected mid-stream replica crash yields
+        ONE merged Chrome trace in which the client-visible trace id
+        filters to the router's route/retry instants AND both replicas'
+        queue/prefill/decode spans on a shared timeline — and the
+        tracing layer itself compiled nothing."""
+        from znicz_tpu.observability import device
+
+        tracer = obs.get_tracer()
+        if tracer.recording:
+            tracer.stop()
+        tracer.start()
+        fleet = _TracedFleet(params)
+        pusher = TracePusher(
+            f"http://127.0.0.1:{collector_srv.server_address[1]}",
+            instance="proc",
+            tracer=tracer,
+            interval_s=30.0,  # pushed by hand below
+        )
+        try:
+            programs_before = device.program_count()
+            gen = np.random.default_rng(37)
+            prompt = gen.integers(0, 17, (2 * BS + 3,)).astype(np.int32)
+            # 2 token records pass, then the router's upstream read
+            # dies: a mid-stream replica crash from the router's view
+            faults.inject("router.stream", after=2, times=1)
+            r = fleet.post(prompt)
+            assert r["status"] == 200
+            assert r["done"]["router"]["retries"] == 1
+            tid = r["trace_header"]
+            assert tid and tid == r["done"]["trace_id"]
+            assert tid.startswith("znicz-router-")  # router-minted
+
+            col = collector_srv.collector
+
+            def filtered():
+                pusher.push_now()
+                merged = col.merged_trace(tid)
+                return [
+                    e for e in merged["traceEvents"] if e["ph"] != "M"
+                ]
+
+            def instances_of(events):
+                return {
+                    (e.get("args") or {}).get("instance")
+                    for e in events
+                }
+
+            # the cancelled first replica retires on its next tick —
+            # wait until BOTH replicas' spans carry the id
+            _wait_until(
+                lambda: {"rep-0", "rep-1"} <= instances_of(filtered()),
+                what="both replicas' spans under one trace id",
+            )
+            events = filtered()
+            names = [e["name"] for e in events]
+            # the router hop: initial route + post-crash retry + reroute
+            assert names.count("router/route") == 2
+            assert names.count("router/retry") == 1
+            assert "router/done" in names
+            # replica lifecycle under the SAME id, on both instances
+            for rep in ("rep-0", "rep-1"):
+                rep_names = {
+                    e["name"] for e in events
+                    if (e.get("args") or {}).get("instance") == rep
+                }
+                assert "frontdoor/submit" in rep_names
+                assert "serve/queued" in rep_names
+                assert "serve/admit" in rep_names, rep_names
+                assert "serve/decode" in rep_names
+            # one shared timeline: every event timestamped, and the
+            # merged view splits into ≥3 pids (router + two replicas)
+            assert all(isinstance(e.get("ts"), float) for e in events)
+            merged = col.merged_trace(tid)
+            meta = {
+                e["args"]["name"]
+                for e in merged["traceEvents"] if e["ph"] == "M"
+            }
+            assert {"rep-0", "rep-1"} <= meta
+            assert any("router" in m for m in meta)
+            # the tracing layer added ZERO compiled programs
+            assert device.program_count() == programs_before
+        finally:
+            pusher.stop()
+            fleet.close()
+            faults.clear()
+            if tracer.recording:
+                tracer.stop()

@@ -97,6 +97,29 @@ class TestMonitorWindows:
         assert w["100"]["n"] == 20.0
         assert w["100"]["bad_frac"] == pytest.approx(0.5)
 
+    def test_latest_burn_matches_the_full_snapshot(self):
+        """The cheap per-tick reduction behind the burn-rate gauge
+        must agree with snapshot()'s max windowed burn (same newest
+        capture, no fresh registry walk)."""
+        r = _reg()
+        mon = SLOMonitor(
+            targets=(TT,), windows_s=(10.0, 100.0), registry=r,
+            min_sample_gap_s=0.0,
+        )
+        assert mon.latest_burn() == 0.0  # empty ring: no judgment
+        mon.sample(now=0.0)
+        _observe(r, "znicz_serve_ttft_seconds", [0.2] * 8 + [0.001] * 2)
+        mon.sample(now=5.0)
+        got = mon.latest_burn()
+        snap = mon.snapshot(now=5.0)
+        want = max(
+            w["burn_rate"]
+            for w in snap["targets"]["ttft"]["windows"].values()
+            if w["n"] > 0
+        )
+        assert got == pytest.approx(want)
+        assert got > 1.0  # 80% bad at a 90% objective: burning
+
     def test_short_uptime_reports_true_span(self):
         r = _reg()
         mon = SLOMonitor(

@@ -118,6 +118,19 @@ def test_thread_safety_rules_are_registered():
     assert RULES["ZNC013"].severity in ("error", "warning")
 
 
+def test_changed_files_gate_is_clean_on_the_live_repo():
+    """Tier-1 runs the real ``znicz-check --changed`` path over this
+    repo: the project index stays clean on exactly the files touched
+    vs HEAD (an uncommitted working tree exercises the filter for
+    real; a committed one proves the path end-to-end with an empty
+    set).  Either way the gate is exit 0 — a finding in a touched
+    file fails CI here before it lands."""
+    from znicz_tpu.analysis.__main__ import main
+
+    rc = main(["--root", REPO_ROOT, "--changed", "HEAD", PKG_DIR])
+    assert rc == 0
+
+
 # -- cross-module traced-context detection (the acceptance fixture) -------
 
 

@@ -185,11 +185,15 @@ def run_router_server(server, router: ServingRouter) -> int:
 
 def main(argv=None) -> int:
     """Usage: ``proxy URL [URL ...] [--port N] [--host H]
-    [--block-size B] [--policy P]`` — replica instance names default
-    to ``replica-<i>``."""
+    [--block-size B] [--policy P] [--collector URL]`` — replica
+    instance names default to ``replica-<i>``; ``--collector`` pushes
+    the router's route/retry spans to a fleet
+    :mod:`~znicz_tpu.observability.collector` so the merged timeline
+    includes the router hop."""
     args = list(sys.argv[1:] if argv is None else argv)
     port, host, block_size = 8080, "127.0.0.1", 16
     policy = "prefix_affinity"
+    collector_url = None
     urls = []
     i = 0
     while i < len(args):
@@ -201,17 +205,23 @@ def main(argv=None) -> int:
             block_size, i = int(args[i + 1]), i + 2
         elif args[i] == "--policy":
             policy, i = args[i + 1], i + 2
+        elif args[i] == "--collector":
+            collector_url, i = args[i + 1], i + 2
         else:
             urls.append(args[i])
             i += 1
     if not urls:
         print(
             "usage: python -m znicz_tpu.cluster.proxy URL [URL ...] "
-            "[--port N] [--host H] [--block-size B] [--policy P]",
+            "[--port N] [--host H] [--block-size B] [--policy P] "
+            "[--collector URL]",
             file=sys.stderr,
         )
         return 2
-    router = ServingRouter(block_size=block_size, policy=policy)
+    router = ServingRouter(
+        block_size=block_size, policy=policy,
+        collector_url=collector_url,
+    )
     for j, url in enumerate(urls):
         router.register(f"replica-{j}", url)
     server = build_router_server(router, port=port, host=host)

@@ -42,8 +42,10 @@ tests via :meth:`ServingRouter.open_stream`.
 from __future__ import annotations
 
 import http.client
+import itertools
 import json
 import logging
+import os
 import socket
 import threading
 import time
@@ -107,7 +109,9 @@ class RoutedStream:
         self._conn: Optional[http.client.HTTPConnection] = None
         self._resp = None
         self.replica: Optional[str] = None  # current upstream instance
-        self.trace_id: Optional[str] = None  # FIRST upstream's trace id
+        # ROUTER-minted trace id (set by open_stream, forwarded inbound
+        # to every replica attempt); stays one id across failovers
+        self.trace_id: Optional[str] = None
         self.overlap = 0  # affinity depth of the current choice
         self.retries = 0  # reported failovers, sheds included
         # the RETRY BUDGET counts only the expensive attempts (connect
@@ -272,7 +276,8 @@ class RoutedStream:
         self._budget_used += 1  # a mid-stream re-route recomputes
         observability.instant(
             "router/retry", reason=exc.reason, gone=self.replica,
-            sent=self._sent,
+            sent=self._sent, trace=self.trace_id,
+            instance=self._router.name,
         )
         try:
             router._connect(self, skip=self._sent)
@@ -324,7 +329,8 @@ class RoutedStream:
                 )
         observability.instant(
             "router/done", replica=self.replica, retries=self.retries,
-            reason=rec.get("finish_reason"),
+            reason=rec.get("finish_reason"), trace=self.trace_id,
+            instance=self._router.name,
         )
         return rec
 
@@ -365,6 +371,8 @@ class ServingRouter:
         retry_after_s: float = 1.0,
         heartbeat_interval_s: float = 2.0,
         name: str = "znicz-router",
+        slo_burn_threshold: float = 1.0,
+        collector_url: Optional[str] = None,
     ):
         if policy not in _POLICIES:
             raise ValueError(
@@ -379,6 +387,23 @@ class ServingRouter:
         self.stream_gap_s = float(stream_gap_s)
         self.retry_after_s = float(retry_after_s)
         self.name = name
+        self.slo_burn_threshold = float(slo_burn_threshold)
+        # router-minted trace ids: ONE id per client request, forwarded
+        # to every replica attempt via X-Znicz-Trace-Id so the whole
+        # failover chain shares a single filterable id (the replica
+        # adopts it; before PR 11 each upstream minted its own)
+        self._ids = itertools.count()
+        self._suffix = os.urandom(3).hex()
+        self._trace_pusher = None
+        if collector_url:
+            # attached, not constructed: a router colocated with its
+            # replicas shares the process pusher (collector.py)
+            from znicz_tpu.observability.collector import attach_pusher
+
+            observability.get_tracer().ensure_recording()
+            self._trace_pusher = attach_pusher(
+                collector_url, instance=name
+            )
         self.affinity = (
             affinity if affinity is not None else PrefixAffinityIndex()
         )
@@ -444,6 +469,11 @@ class ServingRouter:
             )
 
     def close(self) -> None:
+        if self._trace_pusher is not None:
+            from znicz_tpu.observability.collector import detach_pusher
+
+            detach_pusher(self._trace_pusher)
+            self._trace_pusher = None
         if self._owns_registry:
             self.registry.close()
 
@@ -455,19 +485,28 @@ class ServingRouter:
 
     # -- placement ---------------------------------------------------------
 
-    def _load(self, rep: Replica) -> Tuple[float, float]:
-        """Load score (smaller is lighter): queued depth first, then
-        pool headroom.  Heartbeat numbers by default; per-instance
-        aggregator gauges override when pushed (fresher, and pushed on
-        the replica's own cadence rather than the probe's)."""
+    def _load(self, rep: Replica) -> Tuple[float, float, float]:
+        """Load score (smaller is lighter): SLO burn band first (a
+        replica burning its error budget at or past
+        ``slo_burn_threshold`` ranks behind every non-burning peer —
+        the ROADMAP's "/slo burn rates in the load tiebreak", read
+        per-instance off ``znicz_serve_slo_burn_rate``), then queued
+        depth, then pool headroom.  :meth:`rank` lifts the burn band
+        ABOVE affinity overlap (like the health band: a warm cache on
+        a breached replica is still a breached replica), so the
+        guarantee holds even for shared-prefix traffic.  Heartbeat
+        numbers by default; per-instance aggregator gauges override
+        when pushed (fresher, and pushed on the replica's own cadence
+        rather than the probe's)."""
         health = rep.health or {}
         pending = float(health.get("pending", 0) or 0)
         inflight = float(health.get("inflight", 0) or 0)
         frac = health.get("pool_free_frac")
         frac = 1.0 if frac is None else float(frac)
+        burn = None
         agg = self._aggregator
         if agg is not None:
-            # ONE locked aggregator read per replica; the five series
+            # ONE locked aggregator read per replica; the six series
             # come out of the same snapshot
             fams = agg.instance_families(rep.instance)
             v = series_value(fams, "znicz_serve_frontdoor_pending")
@@ -476,6 +515,7 @@ class ServingRouter:
             v = series_value(fams, "znicz_serve_frontdoor_inflight")
             if v is not None:
                 inflight = v
+            burn = series_value(fams, "znicz_serve_slo_burn_rate")
             free = series_value(
                 fams, "znicz_serve_kv_pool_blocks", {"state": "free"}
             )
@@ -489,7 +529,12 @@ class ServingRouter:
                 total = free + (cached or 0.0) + (used or 0.0)
                 if total > 0:
                     frac = (free + (cached or 0.0)) / total
-        return (pending + inflight, -frac)
+        burning = (
+            1.0
+            if burn is not None and burn >= self.slo_burn_threshold
+            else 0.0
+        )
+        return (burning, pending + inflight, -frac)
 
     def rank(
         self, keys: Sequence[str], exclude: Optional[Set[str]] = None
@@ -543,10 +588,16 @@ class ServingRouter:
             r.instance: (i - start) % len(reps)
             for i, r in enumerate(reps)
         }
+        # ONE load read per replica; the burn band sorts ABOVE the
+        # affinity overlap (a burning replica must drain, and affinity
+        # concentrates exactly the traffic that would keep it breached)
+        loads = {r.instance: self._load(r) for r in reps}
         return sorted(
             ((r, overlaps[r.instance]) for r in reps),
-            key=lambda pair: (band(pair[0]), -pair[1],
-                              self._load(pair[0]),
+            key=lambda pair: (band(pair[0]),
+                              loads[pair[0].instance][0],  # burn band
+                              -pair[1],
+                              loads[pair[0].instance][1:],
                               rotation[pair[0].instance]),
         )
 
@@ -600,6 +651,10 @@ class ServingRouter:
         with self._rr_lock:  # shared state lock: rotation + tallies
             self._n_requests += 1
         rs = RoutedStream(self, payload, keys)
+        # mint the trace id HERE: every replica attempt (first choice
+        # and failovers alike) carries it inbound, so one filter shows
+        # the request's whole cross-process life
+        rs.trace_id = f"{self.name}-{self._suffix}-{next(self._ids):06d}"
         try:
             self._connect(rs, skip=0)
         except RejectedError as exc:
@@ -634,7 +689,9 @@ class ServingRouter:
             )
         for rep, overlap in candidates:
             try:
-                conn, resp, trace = self._attempt(rep, rs.payload_now())
+                conn, resp, trace = self._attempt(
+                    rep, rs.payload_now(), trace_id=rs.trace_id
+                )
             except _UpstreamFailure as exc:
                 if exc.reason == "upstream_4xx":
                     # the REPLICA rejected the request as a client
@@ -695,7 +752,8 @@ class ServingRouter:
                 self.affinity.learn(rep.instance, rs._keys)
             observability.instant(
                 "router/route", replica=rep.instance, overlap=overlap,
-                skip=skip, trace=trace,
+                skip=skip, trace=rs.trace_id or trace,
+                instance=self.name,
             )
             return
         if sheds and failures == 0:
@@ -711,9 +769,14 @@ class ServingRouter:
             retry_after_s=max(sheds, default=self.retry_after_s),
         )
 
-    def _attempt(self, rep: Replica, payload: Dict):
-        """One replica connection: POST /generate, demand a streaming
-        200.  Returns ``(conn, resp, trace_id)``; raises
+    def _attempt(
+        self, rep: Replica, payload: Dict,
+        trace_id: Optional[str] = None,
+    ):
+        """One replica connection: POST /generate (forwarding the
+        router-minted trace id via ``X-Znicz-Trace-Id``, which the
+        replica adopts as the request's own), demand a streaming 200.
+        Returns ``(conn, resp, trace_id)``; raises
         :class:`_UpstreamFailure` (reason ``shed`` for 503 — carrying
         its Retry-After — ``upstream_4xx`` for a 400 client-level
         reject, ``upstream_status`` for any other wrong status — a
@@ -724,9 +787,12 @@ class ServingRouter:
         )
         try:
             faults.fire("router.connect")  # injectable connect refusal
+            headers = {"Content-Type": "application/json"}
+            if trace_id:
+                headers["X-Znicz-Trace-Id"] = trace_id
             conn.request(
                 "POST", "/generate", body=json.dumps(payload),
-                headers={"Content-Type": "application/json"},
+                headers=headers,
             )
             resp = conn.getresponse()
             if conn.sock is not None:

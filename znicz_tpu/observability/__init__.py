@@ -20,6 +20,13 @@ generate serve-cache counters, StatusWriter's timing dict):
   service replicas push registry snapshots to (instance-tagged,
   TTL-expired, bucket-wise histogram merge) plus the MetricsPusher
   background thread feeding it.
+* **Fleet tracing** (:mod:`collector`) — the tracing twin: a
+  TraceCollector spans push to (TracePusher), merged into ONE
+  Perfetto-loadable timeline at ``GET /trace`` with pid=instance.
+* **Device/compile telemetry** (:mod:`device`) — the program ledger
+  behind ``/debug/programs`` (compile wall time, cost analysis,
+  executable memory per true first compile) and on-demand
+  ``jax.profiler`` captures.
 * **SLO monitoring** (:mod:`slo`) — rolling-window p50/p95/p99 and
   multi-window burn rates over declared targets (``/slo``,
   ``tools/znicz-slo``).
@@ -34,6 +41,12 @@ from znicz_tpu.observability.aggregate import (  # noqa: F401
     MetricsPusher,
     build_aggregator_server,
 )
+from znicz_tpu.observability.collector import (  # noqa: F401
+    TraceCollector,
+    TracePusher,
+    build_collector_server,
+)
+from znicz_tpu.observability import device  # noqa: F401
 from znicz_tpu.observability.phases import PhaseTimer  # noqa: F401
 from znicz_tpu.observability.registry import (  # noqa: F401
     DEFAULT_TIME_BUCKETS,

@@ -693,6 +693,18 @@ class MetricsPusher:
             "aggregator pushes attempted by this process, by outcome",
             ("status",),
         )
+        # push lag: seconds since the last SUCCESSFUL push, stamped at
+        # each attempt BEFORE the snapshot is taken — so the pushed
+        # snapshot itself carries how stale the previous one was, and a
+        # silently wedged/failing pusher is visible from the fleet view
+        # the moment any push lands again (a fully dead pusher shows as
+        # /instances age_s instead)
+        self._m_lag = self._registry.gauge(
+            "znicz_pusher_lag_seconds",
+            "seconds since this process's last successful aggregator "
+            "push, as of its most recent attempt",
+        )
+        self._last_ok: Optional[float] = None
 
     def start(self) -> "MetricsPusher":
         """Start the background push loop (idempotent)."""
@@ -738,6 +750,12 @@ class MetricsPusher:
 
     def push_now(self) -> bool:
         """One synchronous, bounded push; True on 2xx.  Never raises."""
+        now = time.monotonic()
+        self._m_lag.set(
+            round(now - self._last_ok, 3)
+            if self._last_ok is not None
+            else 0.0
+        )
         try:
             faults.fire("pusher.push")
             body = json.dumps(
@@ -770,6 +788,7 @@ class MetricsPusher:
             return False
         if ok:
             self.pushes_ok += 1
+            self._last_ok = time.monotonic()
             self._m_pushes.labels(status="ok").inc()
         else:
             self.pushes_failed += 1

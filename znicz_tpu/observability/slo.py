@@ -261,6 +261,37 @@ class SLOMonitor:
             self._record(t, state)
         return True
 
+    def latest_burn(self) -> float:
+        """Max burn rate across targets and windows WITH data,
+        evaluated from the ring's newest capture — no fresh registry
+        walk, no rates/percentile computation.  The cheap per-tick
+        reduction behind the ``znicz_serve_slo_burn_rate`` gauge (the
+        front door calls this right after :meth:`maybe_sample`
+        recorded, so the newest capture is current); :meth:`snapshot`
+        stays the full judgment."""
+        with self._ring_lock:
+            ring = list(self._ring)
+        if not ring:
+            return 0.0
+        t_new, current = ring[-1]
+        burn = 0.0
+        for target in self.targets:
+            cur_h = current["hists"].get(target.metric)
+            if cur_h is None:
+                continue
+            for w in self.windows_s:
+                _, base = self._baseline(ring, t_new - w)
+                cum = _delta_cum(
+                    cur_h,
+                    base["hists"].get(target.metric)
+                    if base is not None
+                    else None,
+                )
+                ev = _eval_target(target, cum, span_s=None)
+                if ev["n"] > 0:
+                    burn = max(burn, ev["burn_rate"])
+        return round(burn, 4)
+
     @staticmethod
     def _baseline(
         ring: Sequence[Tuple[float, dict]], t_want: float

@@ -60,14 +60,62 @@ class Tracer:
         self._file_bytes = 0
         self._max_file_bytes = 0
         self._t0 = time.perf_counter()
+        # wall-clock twin of _t0: the collector rebases instances onto
+        # one shared timeline by epoch difference (cross-process clock
+        # alignment is exactly what wall clock is for)
+        self.epoch_us = time.time() * 1e6
         self._max_events = max_events
         self.dropped = 0
         self.rotations = 0
         self._annotation = _UNSET
+        # fleet tracing: the default instance tag every emitted event
+        # carries (pid=instance in the collector's merged view), and
+        # bounded sinks a TracePusher drains span batches from
+        self.instance: Optional[str] = None
+        self._sinks: List = []
 
     @property
     def recording(self) -> bool:
         return self._recording
+
+    def set_instance(self, instance: Optional[str]) -> None:
+        """Default ``instance`` tag stamped into every emitted event's
+        args (explicit per-span ``instance=...`` args win).  The
+        fleet trace collector groups the merged timeline by this tag —
+        one process serving several logical instances (an in-process
+        test fleet) tags per-span instead."""
+        with self._lock:
+            self.instance = instance
+
+    def add_sink(self, maxlen: int = 65536):
+        """Register a BOUNDED event sink (a deque): every emitted event
+        is appended, oldest dropped past ``maxlen`` — the TracePusher's
+        intake.  Returns the deque; detach with :meth:`remove_sink`."""
+        from collections import deque
+
+        q = deque(maxlen=int(maxlen))
+        with self._lock:
+            self._sinks.append(q)
+        return q
+
+    def remove_sink(self, q) -> None:
+        with self._lock:
+            if q in self._sinks:
+                self._sinks.remove(q)
+
+    def ensure_recording(self) -> bool:
+        """Start a buffer-only recording window if none is active (the
+        front door's collector wiring calls this so spans flow without
+        the operator having to start the tracer by hand).  True when
+        THIS call started it."""
+        with self._lock:
+            if self._recording:
+                return False
+        try:
+            self.start()
+        except RuntimeError:
+            return False  # lost the race: someone else just started it
+        return True
 
     def start(
         self,
@@ -92,6 +140,7 @@ class Tracer:
             self.dropped = 0
             self.rotations = 0
             self._t0 = time.perf_counter()
+            self.epoch_us = time.time() * 1e6  # wall twin of _t0
             self._path = path
             self._file = open(path, "w") if path else None
             self._file_bytes = 0
@@ -144,6 +193,13 @@ class Tracer:
         with self._lock:
             if not self._recording:
                 return  # span outlived a stop(): drop, don't corrupt
+            if self.instance is not None:
+                # default instance tag (explicit per-span args win):
+                # the fleet collector's pid=instance grouping key
+                args = ev.setdefault("args", {})
+                args.setdefault("instance", self.instance)
+            for q in self._sinks:
+                q.append(ev)  # bounded: deque maxlen drops the oldest
             # the file streams EVERY event (disk is the durable record);
             # only the in-memory buffer is capped — the file instead
             # ROTATES at max_file_bytes so a long-running server's

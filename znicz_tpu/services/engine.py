@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu import observability
+from znicz_tpu.observability import device as device_telemetry
 from znicz_tpu.services.errors import RequestTooLargeError
 from znicz_tpu.utils import faults, profiling
 from znicz_tpu.workflow.generate import (
@@ -574,6 +575,11 @@ class DecodeEngine:
             help="engine admit/decode host phase seconds",
             span_prefix="serve/",
         )
+        # fleet tracing: the serving instance this engine's spans
+        # belong to (set by the front door; rides every span/instant
+        # as an ``instance`` arg so the trace collector's merged view
+        # can split an in-process fleet into per-instance tracks)
+        self.trace_instance: Optional[str] = None
         self._programs: Dict[tuple, int] = {}
         self._program_hits = 0
         self._next_id = 0
@@ -637,11 +643,33 @@ class DecodeEngine:
         )
         return rid
 
-    @staticmethod
-    def _trace_args(trace_id: Optional[str]) -> Dict:
+    def _trace_args(self, trace_id: Optional[str]) -> Dict:
         """Span/instant args for a trace id — empty when none, so
-        engine-direct callers add no noise to the timeline."""
-        return {"trace": trace_id} if trace_id else {}
+        engine-direct callers add no noise to the timeline.  When the
+        front door names this engine's instance
+        (:attr:`trace_instance`), every span carries it too — the
+        fleet trace collector groups the merged timeline by that tag
+        (pid=instance in Perfetto)."""
+        args: Dict = {}
+        if trace_id:
+            args["trace"] = trace_id
+        if self.trace_instance:
+            args["instance"] = self.trace_instance
+        return args
+
+    def _decode_trace_args(self, residents) -> Dict:
+        """Decode chunks are batched: the span carries EVERY resident's
+        trace id (comma-joined) so ONE Perfetto trace-id filter also
+        surfaces the decode chunks a request was resident in."""
+        args: Dict = {}
+        traces = ",".join(
+            r.trace_id for r in residents if r.trace_id
+        )
+        if traces:
+            args["traces"] = traces
+        if self.trace_instance:
+            args["instance"] = self.trace_instance
+        return args
 
     @property
     def pending(self) -> int:
@@ -675,23 +703,52 @@ class DecodeEngine:
         :meth:`_admit_pending`; the paged subclass interleaves one
         prompt CHUNK per prefilling slot here, between decode chunks."""
 
-    def _program(self, key: tuple) -> None:
+    def _program(self, key: tuple) -> bool:
         """Ledger one executable per key: the compile-count hook's
         ground truth (tests cross-check it against the jit cache).
         Registry mirror: ``znicz_serve_compiles_total{kind,bucket}``
         counts TRUE first compiles per (params geometry, key) across the
         whole process — a second engine with the same geometry rides the
         shared jit caches and adds nothing.  ``key[1]`` is the prompt
-        bucket for admits, the chunk size for the decode program."""
+        bucket for admits, the chunk size for the decode program.
+        Returns True exactly when this call IS a true first compile
+        (the device-ledger hook in :meth:`_timed_program` keys off
+        it, so ``/debug/programs`` stays count-identical to the
+        counter)."""
         if key in self._programs:
             self._program_hits += 1
             self._m_program_hits.inc()
-        else:
-            self._programs[key] = 1
-            full_key = (self._params_fp, key)
-            if full_key not in _COMPILED_KEYS:
-                _COMPILED_KEYS.add(full_key)
-                self._m_compiles.labels(kind=key[0], bucket=key[1]).inc()
+            return False
+        self._programs[key] = 1
+        full_key = (self._params_fp, key)
+        if full_key in _COMPILED_KEYS:
+            return False
+        _COMPILED_KEYS.add(full_key)
+        self._m_compiles.labels(kind=key[0], bucket=key[1]).inc()
+        return True
+
+    def _timed_program(self, key: tuple, fn, *args, **kwargs):
+        """Ledger + invoke one compiled program.  On its TRUE first
+        compile (process-wide, :meth:`_program`'s dedup) the call is
+        wall-timed and recorded into the device ledger
+        (``/debug/programs``, ``znicz_compile_seconds``,
+        ``znicz_program_cost_*``) together with the lowering's cost
+        analysis; steady-state invocations pay one dict lookup and
+        nothing else.  The recorded wall time is the first dispatch —
+        trace + compile + the first execution — which on a first
+        compile is compile-dominated."""
+        if not self._program(key):
+            return fn(*args, **kwargs)
+        cost = device_telemetry.lowered_cost(fn, args, kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        device_telemetry.record_program(
+            key,
+            time.perf_counter() - t0,
+            cost=cost,
+            dedup=(self._params_fp, key),
+        )
+        return out
 
     def _admit_pending(self) -> None:
         for slot in range(self.batch_size):
@@ -714,11 +771,12 @@ class DecodeEngine:
             tokens, start = pack_prompts(
                 [req.prompt], req.bucket, self.pad_id
             )
-            self._program(("admit", req.bucket, self._structure))
             key = jax.random.fold_in(self._rng, self._n_admits)
             self._n_admits += 1
             greedy, top_k, nucleus = self._structure
-            self._caches, first = _admit_row(
+            self._caches, first = self._timed_program(
+                ("admit", req.bucket, self._structure),
+                _admit_row,
                 self.params, self._caches, tokens, start,
                 jnp.int32(slot), self._temperature, self._top_p, key,
                 n_heads=self.n_heads, greedy=greedy, top_k=top_k,
@@ -749,23 +807,28 @@ class DecodeEngine:
             st["req"] for st in self._slots if st is not None
         ]
         t0 = time.perf_counter()
-        with self.timer.phase("decode", active=self.active):
+        with self.timer.phase(
+            "decode", active=self.active,
+            **self._decode_trace_args(residents),
+        ):
             rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
             self._chunk_idx += 1
             greedy, top_k, nucleus = self._structure
-            self._program(
-                ("chunk", self.admit_every, self.batch_size,
-                 self._structure)
-            )
-            (caches, tok, pos, done, remaining, out, steps) = _decode_chunk(
-                self.params, self._caches, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._start),
-                jnp.asarray(self._done), jnp.asarray(self._remaining),
-                self._temperature, self._top_p, rng,
-                chunk=self.admit_every, n_heads=self.n_heads,
-                eos_id=self.eos_id, greedy=greedy, top_k=top_k,
-                nucleus=nucleus, moe_top_k=self.moe_top_k,
-                moe_dispatch=self.moe_dispatch,
+            (caches, tok, pos, done, remaining, out, steps) = (
+                self._timed_program(
+                    ("chunk", self.admit_every, self.batch_size,
+                     self._structure),
+                    _decode_chunk,
+                    self.params, self._caches, jnp.asarray(self._tok),
+                    jnp.asarray(self._pos), jnp.asarray(self._start),
+                    jnp.asarray(self._done),
+                    jnp.asarray(self._remaining),
+                    self._temperature, self._top_p, rng,
+                    chunk=self.admit_every, n_heads=self.n_heads,
+                    eos_id=self.eos_id, greedy=greedy, top_k=top_k,
+                    nucleus=nucleus, moe_top_k=self.moe_top_k,
+                    moe_dispatch=self.moe_dispatch,
+                )
             )
             self._caches = caches
             # ONE host sync per chunk — the admission granularity; the
@@ -1090,9 +1153,23 @@ class PagedDecodeEngine(DecodeEngine):
         # already produced once — re-observing would double-count)
         self._admitted_ids: set = set()
         self._n_preempted = 0
+        # per-block K/V footprint across the whole tower — the byte
+        # twin of the block gauges, so pool pressure is readable in
+        # the same unit device memory is
+        self.block_bytes = sum(
+            2 * int(np.prod(p["k"].shape[1:]))
+            * np.dtype(p["k"].dtype).itemsize
+            for p in self._pools
+        )
         self._m_pool = observability.gauge(
             "znicz_serve_kv_pool_blocks",
             "paged KV pool blocks by state (the null block is excluded)",
+            ("state",),
+        )
+        self._m_pool_bytes = observability.gauge(
+            "znicz_serve_kv_pool_bytes",
+            "paged KV pool bytes by state (blocks x per-block K/V "
+            "bytes across the tower; the null block is excluded)",
             ("state",),
         )
         self._m_preempted = observability.counter(
@@ -1150,11 +1227,14 @@ class PagedDecodeEngine(DecodeEngine):
     def _update_pool_gauges(self) -> None:
         free = len(self._free)
         cached = len(self._lru)
+        used = self.usable_blocks - free - cached
         self._m_pool.labels(state="free").set(free)
         self._m_pool.labels(state="cached").set(cached)
-        self._m_pool.labels(state="used").set(
-            self.usable_blocks - free - cached
-        )
+        self._m_pool.labels(state="used").set(used)
+        bb = self.block_bytes
+        self._m_pool_bytes.labels(state="free").set(free * bb)
+        self._m_pool_bytes.labels(state="cached").set(cached * bb)
+        self._m_pool_bytes.labels(state="used").set(used * bb)
 
     def _slots_by_age(self) -> List[int]:
         """Occupied slot indices, oldest admission first — allocation
@@ -1294,9 +1374,10 @@ class PagedDecodeEngine(DecodeEngine):
         if new is None:
             return False
         if copy:
-            self._program(("cow", self.block_size))
-            self._pools = _cow_copy_prog(
-                self._pools, jnp.int32(blk), jnp.int32(new)
+            self._pools = self._timed_program(
+                ("cow", self.block_size),
+                _cow_copy_prog,
+                self._pools, jnp.int32(blk), jnp.int32(new),
             )
         self._decref(blk)
         self._row_blocks[slot][j] = new
@@ -1554,9 +1635,10 @@ class PagedDecodeEngine(DecodeEngine):
             request=req.id, bucket=req.bucket, chunk=c,
             **self._trace_args(req.trace_id),
         ):
-            self._program(("prefill", self.block_size, self._structure))
             key = jax.random.fold_in(self._rng, st["seq"])
-            self._pools, first = _paged_prefill_prog(
+            self._pools, first = self._timed_program(
+                ("prefill", self.block_size, self._structure),
+                _paged_prefill_prog,
                 self.params, self._pools,
                 jnp.asarray(self._tables[slot]),
                 jnp.asarray(
@@ -1700,16 +1782,18 @@ class PagedDecodeEngine(DecodeEngine):
             if s is not None and s["mode"] == "decode"
         ]
         t0 = time.perf_counter()
-        with self.timer.phase("decode", active=self.active):
+        with self.timer.phase(
+            "decode", active=self.active,
+            **self._decode_trace_args(residents),
+        ):
             rng = jax.random.fold_in(self._rng, 1 << 20 | self._chunk_idx)
             self._chunk_idx += 1
             greedy, top_k, nucleus = self._structure
-            self._program(
-                ("paged_chunk", self.admit_every, self.batch_size,
-                 window, self._structure)
-            )
             (pools, tok, pos, done, remaining, out, steps) = (
-                _paged_decode_chunk(
+                self._timed_program(
+                    ("paged_chunk", self.admit_every, self.batch_size,
+                     window, self._structure),
+                    _paged_decode_chunk,
                     self.params, self._pools,
                     jnp.asarray(self._tables[:, :window]),
                     jnp.asarray(self._tok), jnp.asarray(self._pos),
@@ -1788,6 +1872,8 @@ class PagedDecodeEngine(DecodeEngine):
             "pool_blocks_free": len(self._free) + len(self._lru),
             "pool_blocks_cached": len(self._lru),
             "block_size": self.block_size,
+            "block_bytes": self.block_bytes,
+            "pool_bytes": self.usable_blocks * self.block_bytes,
             "preemptions": self._n_preempted,
             "prefix_cache": {
                 "enabled": self.prefix_cache,

@@ -69,6 +69,11 @@ import numpy as np
 
 from znicz_tpu import observability
 from znicz_tpu.observability.aggregate import MetricsPusher
+from znicz_tpu.observability.collector import (
+    TracePusher,
+    attach_pusher,
+    detach_pusher,
+)
 from znicz_tpu.observability.slo import FRONTDOOR_TARGETS, SLOMonitor
 from znicz_tpu.services.engine import (
     Completion,
@@ -225,6 +230,8 @@ class ServingFrontDoor:
         aggregator_url: Optional[str] = None,
         instance: Optional[str] = None,
         push_interval_s: float = 15.0,
+        collector_url: Optional[str] = None,
+        trace_push_interval_s: float = 2.0,
     ):
         if max_pending < 1:
             raise ValueError(f"want max_pending >= 1; got {max_pending}")
@@ -260,6 +267,11 @@ class ServingFrontDoor:
         # unique across restarts of the whole process
         self._ids = itertools.count()
         self._suffix = os.urandom(3).hex()
+        # the serving instance name: the metrics-push tag, AND the
+        # ``instance`` arg every span this door (and its engine) emits
+        # carries — the trace collector's per-instance track key
+        self.instance = instance or f"{name}-{self._suffix}"
+        self._engine.trace_instance = self.instance
         # /debug/requests ring: the last K request summaries (newest
         # last), appended by the engine thread, read under the lock
         self._recent: "deque" = deque(maxlen=max(int(debug_requests), 1))
@@ -287,13 +299,41 @@ class ServingFrontDoor:
         self._slo.sample()
         # fleet aggregation: push this process's registry to a
         # MetricsAggregator so N replicas land in one /metrics
+        # pusher wiring is all-or-nothing: a bad URL must fail the
+        # constructor WITHOUT leaking an already-started background
+        # pusher thread (the half-built door is discarded and close()
+        # never runs on it)
         self._pusher: Optional[MetricsPusher] = None
-        if aggregator_url:
-            self._pusher = MetricsPusher(
-                aggregator_url,
-                instance=instance or f"{name}-{self._suffix}",
-                interval_s=push_interval_s,
-            ).start()
+        self._trace_pusher: Optional[TracePusher] = None
+        try:
+            # fleet tracing: push this process's spans to a
+            # TraceCollector so N replicas land in one merged Perfetto
+            # timeline.  The tracer must be recording for spans to
+            # exist at all — start a buffer-only window if the
+            # operator has not.  Attached (not constructed):
+            # in-process colocations sharing one tracer must share ONE
+            # pusher or every span pushes N times
+            if collector_url:
+                observability.get_tracer().ensure_recording()
+                self._trace_pusher = attach_pusher(
+                    collector_url,
+                    instance=self.instance,
+                    interval_s=trace_push_interval_s,
+                )
+            if aggregator_url:
+                self._pusher = MetricsPusher(
+                    aggregator_url,
+                    instance=self.instance,
+                    interval_s=push_interval_s,
+                ).start()
+        except Exception:
+            if self._trace_pusher is not None:
+                detach_pusher(self._trace_pusher)
+                self._trace_pusher = None
+            if self._pusher is not None:
+                self._pusher.stop(timeout=0.1)
+                self._pusher = None
+            raise
         # per-instance tallies (the registry counters are process-wide)
         self._n_submitted = 0
         self._n_completed = 0
@@ -353,6 +393,16 @@ class ServingFrontDoor:
             "znicz_serve_frontdoor_latency_seconds",
             "front-door submit -> completion delivery (client clock)",
         )
+        # the SLO judgment as ONE routable number: the max burn rate
+        # across targets/windows with data, refreshed on the SLO
+        # sample cadence.  A per-instance read through the aggregator
+        # lets the cluster router steer traffic away from a replica
+        # that is burning its error budget (docs/SERVING.md)
+        self._m_burn = observability.gauge(
+            "znicz_serve_slo_burn_rate",
+            "max SLO burn rate across targets and windows with data "
+            "(the router load tiebreak's per-instance input)",
+        )
         self._thread = threading.Thread(
             target=self._serve_loop, name=f"{name}-frontdoor", daemon=True
         )
@@ -371,6 +421,7 @@ class ServingFrontDoor:
         max_new_tokens: int,
         *,
         deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> RequestHandle:
         """Accept one request; returns its :class:`RequestHandle`.
         Single-flight validation happens HERE (before enqueue):
@@ -378,7 +429,11 @@ class ServingFrontDoor:
         :class:`RequestTooLargeError`, a closed door
         :class:`EngineClosedError`, and load shedding
         :class:`RejectedError` — nothing invalid ever occupies a queue
-        slot."""
+        slot.  ``trace_id`` adopts an INBOUND id (the HTTP surface
+        passes ``X-Znicz-Trace-Id`` through; the cluster router mints
+        one per client request) so one id threads router → replica →
+        engine spans instead of each process minting its own; omitted,
+        the door mints as before."""
         try:
             p = np.asarray(prompt, np.int32).reshape(-1)
         except (TypeError, ValueError) as exc:
@@ -436,7 +491,7 @@ class ServingFrontDoor:
                     reason="pool_pressure",
                     retry_after_s=self.retry_after_s,
                 )
-            tid = f"{self.name}-{self._suffix}-{next(self._ids):06d}"
+            tid = self._mint_id(trace_id)
             handle = RequestHandle(self, tid)
             fr = _FrontRequest(
                 trace_id=tid,
@@ -454,9 +509,25 @@ class ServingFrontDoor:
             self._by_id[tid] = fr
             self._n_submitted += 1
             self._m_pending.set(len(self._pending))
-        observability.instant("frontdoor/submit", id=tid)
+        observability.instant(
+            "frontdoor/submit", id=tid, instance=self.instance
+        )
         self._wake.set()
         return handle
+
+    def _mint_id(self, trace_id: Optional[str]) -> str:
+        """The request's trace id (lock held by the caller): the
+        inbound id verbatim when given and not currently live; a live
+        collision keeps the inbound id as a PREFIX (``-r<n>`` suffix)
+        so a Perfetto substring filter still finds it; else a minted
+        ``<name>-<suffix>-<n>`` id."""
+        if trace_id:
+            tid = str(trace_id).strip()[:128]
+            if tid and tid not in self._by_id:
+                return tid
+            if tid:
+                return f"{tid}-r{next(self._ids):04d}"
+        return f"{self.name}-{self._suffix}-{next(self._ids):06d}"
 
     def cancel(self, trace_id: str) -> bool:
         """Request cancellation of ``trace_id`` — valid before
@@ -498,6 +569,12 @@ class ServingFrontDoor:
             # final flush AFTER the drain: the aggregator's last view of
             # this instance includes the shutdown-path counters
             self._pusher.stop()
+        if self._trace_pusher is not None:
+            # same contract for spans: the final requests' lifecycle
+            # events land in the collector before the door goes away
+            # (shared pusher: the LAST detaching component flushes)
+            detach_pusher(self._trace_pusher)
+            self._trace_pusher = None
 
     def __enter__(self) -> "ServingFrontDoor":
         return self
@@ -638,7 +715,12 @@ class ServingFrontDoor:
                     eng._run_chunk()
             self._stream_and_collect()
             self._publish_gauges()
-            self._slo.maybe_sample()
+            if self._slo.maybe_sample():
+                # the sample cadence is also the burn-gauge cadence:
+                # the router's load tiebreak reads this per-instance
+                # through the aggregator (ROADMAP: /slo burn rates in
+                # the tiebreak)
+                self._publish_burn()
         finally:
             self._last_tick = time.monotonic()
             self._tick_started = None
@@ -858,6 +940,7 @@ class ServingFrontDoor:
             id=fr.trace_id,
             reason=comp.finish_reason,
             latency_ms=round(1000.0 * fr.watch.elapsed(), 1),
+            instance=self.instance,
         )
 
     def _local_completion(
@@ -955,6 +1038,7 @@ class ServingFrontDoor:
             self._failed = True
             self._shed_requested = True  # next tick sheds the queue
             return
+        new_engine.trace_instance = self.instance
         with self._lock:
             self._engine = new_engine
         self._wake.set()
@@ -990,6 +1074,14 @@ class ServingFrontDoor:
         if frac is not None:
             with self._lock:  # submit()'s shed check reads it locked
                 self._pool_free_frac = frac
+
+    def _publish_burn(self) -> None:
+        """Fold the rolling SLO judgment into the burn-rate gauge
+        (engine thread, SLO sample cadence).  ``latest_burn`` reduces
+        the capture :meth:`SLOMonitor.maybe_sample` just recorded —
+        no second registry walk, no rates/percentiles computed only
+        to be thrown away."""
+        self._m_burn.set(self._slo.latest_burn())
 
     def _reject(self, reason: str) -> None:
         """Tally one shed submission (lock held by the caller)."""

@@ -49,6 +49,7 @@ import os
 import signal
 import sys
 import threading
+import urllib.parse
 
 from znicz_tpu.observability import get_registry, parse_prometheus_text
 from znicz_tpu.services.errors import (
@@ -180,6 +181,14 @@ class StatusRequestHandler(
                 )
             else:
                 self._send_json({"requests": fd.recent_requests()})
+        elif path == "/debug/programs":
+            # the device/compile ledger: every true first compile with
+            # its wall time, cost analysis and memory analysis — the
+            # count matches the engine ledger and
+            # znicz_serve_compiles_total by construction
+            from znicz_tpu.observability import device
+
+            self._send_json(device.ledger_snapshot())
         elif path == "/metrics":
             prom = os.path.join(self.directory, "metrics.prom")
             if os.path.exists(prom):
@@ -240,9 +249,12 @@ class StatusRequestHandler(
     # -- the serving front door -------------------------------------------
 
     def do_POST(self):  # noqa: N802 — http.server API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/prefix_probe":
             self._do_prefix_probe()
+            return
+        if path == "/debug/profile":
+            self._do_profile(query)
             return
         if path != "/generate":
             self.send_error(404, "unknown endpoint")
@@ -268,9 +280,18 @@ class StatusRequestHandler(
                 {"error": "bad_request", "detail": str(exc)}, status=400
             )
             return
+        # trace-context propagation: an inbound X-Znicz-Trace-Id (the
+        # cluster router mints one per client request) becomes THIS
+        # request's trace id, so the router's route/retry spans and
+        # every replica's engine spans share one filterable id —
+        # instead of each process minting its own
+        inbound_trace = self.headers.get("X-Znicz-Trace-Id")
+        if inbound_trace:
+            inbound_trace = inbound_trace.strip()[:128] or None
         try:
             handle = fd.submit(
-                prompt, max_new, deadline_s=deadline_s
+                prompt, max_new, deadline_s=deadline_s,
+                trace_id=inbound_trace,
             )
         except RejectedError as exc:
             self._send_json(
@@ -302,6 +323,55 @@ class StatusRequestHandler(
             )
             return
         self._stream_generation(fd, handle)
+
+    def _do_profile(self, query: str) -> None:
+        """``POST /debug/profile?seconds=N`` — one on-demand
+        ``jax.profiler`` device capture, host-span aligned
+        (:func:`znicz_tpu.observability.device.capture_profile`).
+        Answers the capture directory; 409 while another capture runs,
+        400 on a malformed duration."""
+        from znicz_tpu.observability import device
+
+        # drain any request body first: HTTP/1.1 keep-alive reuses the
+        # socket, and unread body bytes would be parsed as the NEXT
+        # request's start line (every other POST handler reads it)
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            if n:
+                self.rfile.read(n)
+        except (TypeError, ValueError):  # znicz-check: disable=ZNC008
+            # a garbage Content-Length only matters for keep-alive
+            # reuse; the capture itself proceeds either way
+            logger.debug("unparseable Content-Length on /debug/profile")
+        try:
+            qs = urllib.parse.parse_qs(query)
+            seconds = float(qs.get("seconds", ["1.0"])[0])
+        except (TypeError, ValueError) as exc:
+            self._send_json(
+                {"error": "bad_request", "detail": str(exc)}, status=400
+            )
+            return
+        try:
+            result = device.capture_profile(seconds)
+        except ValueError as exc:
+            # non-finite duration ("nan"/"inf" parse as floats but
+            # cannot time a capture): a client error, answered 400
+            self._send_json(
+                {"error": "bad_request", "detail": str(exc)}, status=400
+            )
+            return
+        except RuntimeError as exc:
+            busy = "already running" in str(exc)
+            self._send_json(
+                {
+                    "error": "profile_busy" if busy
+                    else "profiler_unavailable",
+                    "detail": str(exc),
+                },
+                status=409 if busy else 503,
+            )
+            return
+        self._send_json({"ok": True, **result})
 
     def _do_prefix_probe(self) -> None:
         """``POST /prefix_probe`` ``{"prompt": [ids]}`` — the front

@@ -28,6 +28,7 @@ tests assert position-by-position.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional, Sequence
 
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu import observability
+from znicz_tpu.observability import device as device_telemetry
 from znicz_tpu.ops.attention import paged_attention
 from znicz_tpu.ops.normalization import layer_norm
 from znicz_tpu.workflow.transformer import _block_ffn
@@ -754,14 +756,32 @@ def generate_serve(
     budget = jnp.int32(max_new_tokens)
     compiled = _serve_cache.programs.get(key)
     if compiled is None:
-        compiled = _generate_impl.lower(
+        t0 = time.perf_counter()
+        lowered = _generate_impl.lower(
             params, padded, start, budget, temperature, top_p, rng,
             n_heads=n_heads, max_new_tokens=bucket_new, greedy=greedy,
             top_k=top_k, nucleus=nucleus, eos_id=eos_id,
             moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
-        ).compile()
+        )
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
         _serve_cache.programs[key] = compiled
         _serve_cache.record_compile()
+        # device/compile telemetry: the AOT path has the real compile
+        # wall time AND the Compiled in hand, so the ledger entry gets
+        # exact cost + memory analysis (graceful None where jax lacks
+        # the API)
+        device_telemetry.record_program(
+            ("serve", bucket_tp, bucket_new, b, greedy, top_k, nucleus),
+            compile_s,
+            source="serve_cache",
+            cost=(
+                device_telemetry.stage_cost(compiled)
+                or device_telemetry.stage_cost(lowered)
+            ),
+            memory=device_telemetry.compiled_memory(compiled),
+            dedup=key,
+        )
     else:
         _serve_cache.record_hit()
     out = compiled(params, padded, start, budget, temperature, top_p, rng)
