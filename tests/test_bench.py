@@ -234,6 +234,31 @@ class TestBenchDiff:
             [old, new, "--lower", "oddly_named"]
         ) == 1
 
+    def test_spec_metrics_are_higher_better(self, tmp_path):
+        # ISSUE 12 satellite: the new speculative-serving metrics are
+        # throughput-shaped — a DROP in acceptance rate or the
+        # vs-baseline ratio is the regression, a rise never is
+        for name in (
+            "lm_serve_spec_acceptance_rate",
+            "lm_serve_spec_vs_baseline",
+        ):
+            assert bench_diff.metric_direction(name, set(), set()) == (
+                "higher"
+            )
+            old = _round_file(tmp_path, "old.json", {name: 1.0})
+            new = _round_file(tmp_path, "new.json", {name: 0.5})
+            assert bench_diff.main([old, new]) == 1  # drop regresses
+            assert bench_diff.main([new, old]) == 0  # rise is fine
+        # the marker beats embedded lower-better substrings ("_ms"
+        # etc. never hijack an acceptance-rate family name)
+        assert bench_diff.metric_direction(
+            "spec_ttft_acceptance_rate", set(), set()
+        ) == "higher"
+        # while the spec COMPILE count stays lower-better
+        assert bench_diff.metric_direction(
+            "lm_serve_spec_compiles", set(), set()
+        ) == "lower"
+
     def test_json_output_shape(self, tmp_path, capsys):
         old = _round_file(tmp_path, "old.json", {"r_per_sec": 1.0})
         new = _round_file(tmp_path, "new.json", {"r_per_sec": 0.5})

@@ -527,7 +527,8 @@ class TestCompileBudget:
 
 
 _TIMING_KEYS = {
-    "queue_s", "prefill_s", "decode_s", "preemptions", "cached_tokens"
+    "queue_s", "prefill_s", "decode_s", "preemptions", "cached_tokens",
+    "spec_drafted", "spec_accepted",
 }
 
 
@@ -754,3 +755,64 @@ class TestFaultsHarness:
         faults.clear()
         with pytest.raises(ValueError, match="unknown field"):
             faults._parse_env("q.r:bogus=1")
+
+
+class TestSpeculativeFrontDoor:
+    """ISSUE 12 plumb-through: a spec_k factory serves through the
+    front door on the same tick loop — streams stay golden, the
+    completion timings carry the spec tallies, and a watchdog restart
+    rebuilds a SPECULATING engine from the factory."""
+
+    def test_spec_engine_streams_golden_with_timings(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params, spec_k=7), max_pending=8
+        ) as door:
+            prompts = _prompts(3)
+            handles = [door.submit(p, 16) for p in prompts]
+            for h, p in zip(handles, prompts):
+                toks = list(h.tokens(timeout=30.0))
+                comp = h.result(timeout=5.0)
+                ref = _reference(params, p, 16)
+                assert np.array_equal(comp.tokens, ref)
+                assert toks == list(ref[len(p):])
+                assert "spec_drafted" in comp.timings
+                assert "spec_accepted" in comp.timings
+            st = door.stats()["engine"]["spec"]
+            assert st["enabled"] and st["k"] == 7
+            assert st["drafted"] == st["accepted"] + st["rejected"]
+
+    def test_watchdog_restart_preserves_spec_config(self, params):
+        with ServingFrontDoor(
+            _engine_factory(params, spec_k=7), max_pending=8
+        ) as door:
+            p = _long_prompt(params)
+            with faults.injected(
+                "engine.decode_step", exc=RuntimeError("chip fell over"),
+                times=1,
+            ):
+                h = door.submit(p, 40)
+                comp = h.result(timeout=30.0)
+            assert comp.finish_reason == "error"
+            _wait_until(
+                lambda: door.engine is not None
+                and door.engine.spec_k == 7,
+                what="rebuilt spec engine",
+            )
+            # the rebuilt engine speculates and stays golden
+            h2 = door.submit(p, 12)
+            assert np.array_equal(
+                h2.result(timeout=30.0).tokens,
+                _reference(params, p, 12),
+            )
+            assert door.stats()["watchdog_restarts"] == 1
+
+    def test_dense_factory_with_spec_fails_construction(self, params):
+        from znicz_tpu.services import SpeculationUnsupportedError
+
+        def bad_factory():
+            return DecodeEngine(
+                params, n_heads=HEADS, eos_id=EOS, spec_k=4
+            )
+
+        with pytest.raises(SpeculationUnsupportedError):
+            ServingFrontDoor(bad_factory, max_pending=4)

@@ -403,7 +403,7 @@ def test_done_record_carries_timings_breakdown(http_door):
     t = done["timings"]
     assert set(t) == {
         "queue_s", "prefill_s", "decode_s", "preemptions",
-        "cached_tokens",
+        "cached_tokens", "spec_drafted", "spec_accepted",
     }
     assert t["prefill_s"] > 0.0  # it really ran a prefill
     assert done["ttft_ms"] is not None and done["ttft_ms"] > 0.0

@@ -22,6 +22,7 @@ from znicz_tpu.services.errors import (  # noqa: F401
     EngineClosedError,
     RejectedError,
     RequestTooLargeError,
+    SpeculationUnsupportedError,
     retryable,
 )
 from znicz_tpu.services.frontdoor import (  # noqa: F401

@@ -62,12 +62,18 @@ import numpy as np
 
 from znicz_tpu import observability
 from znicz_tpu.observability import device as device_telemetry
-from znicz_tpu.services.errors import RequestTooLargeError
+from znicz_tpu.services.errors import (
+    RequestTooLargeError,
+    SpeculationUnsupportedError,
+)
 from znicz_tpu.utils import faults, profiling
 from znicz_tpu.workflow.generate import (
     DEFAULT_PROMPT_BUCKETS,
+    DEFAULT_SPEC_BUCKETS,
     NULL_BLOCK,
+    PromptLookupDrafter,
     _check_sampling_args,
+    _filter_logits,
     _params_fingerprint,
     _sample,
     bucket_for,
@@ -78,6 +84,7 @@ from znicz_tpu.workflow.generate import (
     pack_prompts,
     paged_decode_step,
     paged_prefill_chunk,
+    paged_verify_chunk,
     prefill,
 )
 
@@ -142,6 +149,10 @@ class RequestTimings:
     * ``preemptions`` — times this request was evicted and recomputed.
     * ``cached_tokens`` — prompt tokens whose prefill was skipped via
       the prefix cache (accumulated across re-admissions).
+    * ``spec_drafted`` / ``spec_accepted`` — draft tokens proposed for
+      (and accepted by) this request's speculative verify steps; their
+      ratio is the per-request acceptance rate, the number that says
+      whether speculation paid for THIS request.
     """
 
     queue_s: float = 0.0
@@ -149,6 +160,8 @@ class RequestTimings:
     decode_s: float = 0.0
     preemptions: int = 0
     cached_tokens: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     def as_dict(self) -> Dict:
         return {
@@ -157,6 +170,8 @@ class RequestTimings:
             "decode_s": round(self.decode_s, 6),
             "preemptions": self.preemptions,
             "cached_tokens": self.cached_tokens,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
         }
 
 
@@ -442,6 +457,92 @@ def _paged_decode_chunk(
     return pools, tok, pos, done, remaining, out, i
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "width", "block_size", "n_heads", "greedy", "top_k", "nucleus",
+        "moe_top_k", "moe_dispatch",
+    ),
+    donate_argnums=(1,),
+)
+def _paged_verify_prog(
+    params, pools, tables, tokens, pos, start, done, n_write,
+    draft_len, temperature, top_p, rng, *, width, block_size, n_heads,
+    greedy, top_k, nucleus, moe_top_k, moe_dispatch,
+):
+    """Speculative VERIFY: score ``width`` input tokens per row — the
+    row's current last token plus its drafted continuation — in ONE
+    forward pass through the paged attention path
+    (:func:`paged_verify_chunk`), then keep each row's longest agreeing
+    prefix.
+
+    Returns ``(pools, out [B, width], n_accept [B])``: the host emits
+    ``out[b, :n_accept[b] + 1]`` — the accepted drafts plus one BONUS
+    token (the verifier's own prediction at the first disagreement, or
+    past the last accepted draft) — and advances the row's state by
+    that many positions.  Greedy: acceptance is exact argmax agreement
+    position by position, so the emitted chain is token-identical to
+    non-speculative decode (``out`` IS the greedy prediction at every
+    position, conditioned on the drafts before it — valid exactly up to
+    and including the bonus slot, which is all the host reads).
+    Sampled: standard speculative rejection against the drafter's
+    point-mass proposal — draft ``d`` at a position is accepted with
+    probability ``p(d)`` under the FILTERED target distribution
+    (:func:`~znicz_tpu.workflow.generate._filter_logits` — the same
+    truncation :func:`_sample` draws through), a rejection resamples
+    from the residual (``p`` with ``d`` masked out), and a position
+    with no draft samples ``p`` directly — the emitted marginal is the
+    target distribution exactly (Leviathan et al. 2023).
+
+    ``width`` is the bucketed verify shape; ``draft_len``/``n_write``
+    are TRACED [B] operands, so rows with shorter drafts, smaller
+    budgets, or no draft at all (emit 1 token — a plain decode step's
+    worth) ride the same compiled program: zero new programs per
+    accepted length."""
+    b = tokens.shape[0]
+    idx = jnp.arange(width)[None, :]
+    wmask = (~done)[:, None] & (idx < n_write[:, None])
+    pools, logits = paged_verify_chunk(
+        params, pools, tables, tokens, pos, n_heads=n_heads,
+        block_size=block_size, start=start, write_mask=wmask,
+        moe_top_k=moe_top_k, moe_dispatch=moe_dispatch,
+    )
+    # position i predicts the token AFTER input token i; the draft for
+    # it is tokens[:, i+1], which exists iff i < draft_len
+    has_draft = idx < draft_len[:, None]
+    d_next = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1
+    )
+    if greedy:
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        acc = (out == d_next) & has_draft
+    else:
+        flt = _filter_logits(logits, temperature, top_k, nucleus, top_p)
+        probs = jax.nn.softmax(flt, axis=-1)
+        p_draft = jnp.take_along_axis(probs, d_next[..., None], axis=-1)[
+            ..., 0
+        ]
+        u = jax.random.uniform(jax.random.fold_in(rng, 0), p_draft.shape)
+        acc = (u <= p_draft) & has_draft
+        # correction at a drafted position resamples the RESIDUAL (the
+        # rejected draft masked out); an undrafted position samples the
+        # filtered distribution directly (the plain-decode draw)
+        vocab = flt.shape[-1]
+        is_d = (
+            jnp.arange(vocab)[None, None, :] == d_next[..., None]
+        ) & has_draft[..., None]
+        corr = jax.random.categorical(
+            jax.random.fold_in(rng, 1),
+            jnp.where(is_d, -jnp.inf, flt),
+            axis=-1,
+        ).astype(jnp.int32)
+        out = jnp.where(acc, d_next, corr)
+    n_accept = jnp.sum(
+        jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1
+    )
+    return pools, out, n_accept
+
+
 class DecodeEngine:
     """Continuous micro-batching front-end over the KV-cache decoder.
 
@@ -478,6 +579,9 @@ class DecodeEngine:
         moe_top_k: int = 1,
         moe_dispatch: str = "dense",
         prefix_cache: Optional[bool] = None,
+        spec_k: int = 0,
+        drafter=None,
+        spec_buckets: Optional[Sequence[int]] = None,
     ):
         if batch_size < 1 or admit_every < 1:
             raise ValueError(
@@ -490,6 +594,19 @@ class DecodeEngine:
                 "(PagedDecodeEngine): the dense [B, T_max] KV layout has "
                 "no shareable blocks to map across requests"
             )
+        if spec_k or drafter is not None or spec_buckets is not None:
+            # typed CONFIG error (docs/SERVING.md failure taxonomy):
+            # rollback of rejected drafts is a block-table truncate,
+            # which the dense layout has no tables to perform
+            raise SpeculationUnsupportedError(
+                "speculative decoding requires the paged backend "
+                "(PagedDecodeEngine): rejected draft tokens roll back "
+                "by truncating the row's block table — the dense "
+                "[B, T_max] KV layout has no block tables to truncate"
+            )
+        if not hasattr(self, "spec_k"):
+            self.spec_k = 0  # the stats() spec sub-dict reads this
+            # (the paged subclass sets its own before delegating here)
         max_pos = params[0]["pos"].shape[0]
         self.t_max = int(max_seq or max_pos)
         if self.t_max > max_pos:
@@ -977,9 +1094,17 @@ class DecodeEngine:
             "chunk_jit_entries": _decode_chunk._cache_size(),
         }
 
+    def spec_stats(self) -> Dict:
+        """The ``spec`` sub-dict of :meth:`stats`: the dense backend
+        cannot speculate (construction rejects it), so its answer is
+        the disabled report — callers read ONE shape whichever backend
+        serves (the paged subclass overrides with the live tallies)."""
+        return {"enabled": False}
+
     def stats(self) -> Dict:
         """Serving report: completions, generated tokens, the per-request
-        latency aggregate, per-phase host timings, and compile counts.
+        latency aggregate, per-phase host timings, compile counts and
+        the speculative-decoding sub-dict (:meth:`spec_stats`).
         ``peak_active`` is the max rows decoding in one chunk — the
         engine's observed concurrency (the paged backend's headline)."""
         return {
@@ -989,6 +1114,7 @@ class DecodeEngine:
             "peak_active": self._peak_active,
             "latency": self.latency.summary(),
             "phases": self.timer.summary(),
+            "spec": self.spec_stats(),
             **self.compile_stats(),
         }
 
@@ -1081,6 +1207,9 @@ class PagedDecodeEngine(DecodeEngine):
         rng: Optional[jax.Array] = None,
         moe_top_k: int = 1,
         moe_dispatch: str = "dense",
+        spec_k: int = 0,
+        drafter=None,
+        spec_buckets: Sequence[int] = DEFAULT_SPEC_BUCKETS,
     ):
         if block_size < 1:
             raise ValueError(f"want block_size >= 1; got {block_size}")
@@ -1091,6 +1220,36 @@ class PagedDecodeEngine(DecodeEngine):
         self.prefix_cache = True if prefix_cache is None else bool(
             prefix_cache
         )
+        # speculative decoding (docs/SERVING.md "Speculative decoding"):
+        # spec_k == 0 is OFF (the plain decode chunk runs); > 0 drafts
+        # up to spec_k tokens per decoding row each tick and verifies
+        # them in one bucketed forward pass.  The drafter is duck-typed
+        # (``propose(context, k)``) — prompt-lookup by default, a
+        # draft-model drafter plugs into the same hook.
+        if spec_k < 0:
+            raise ValueError(f"want spec_k >= 0; got {spec_k}")
+        self.spec_k = int(spec_k)
+        self.spec_buckets = tuple(int(w) for w in spec_buckets)
+        if (
+            not self.spec_buckets
+            or min(self.spec_buckets) < 2
+            or list(self.spec_buckets)
+            != sorted(set(self.spec_buckets))
+        ):
+            raise ValueError(
+                "spec_buckets must be strictly increasing verify "
+                f"widths >= 2 (k+1 rungs); got {spec_buckets}"
+            )
+        if drafter is not None and not self.spec_k:
+            # silently serving with speculation OFF would be a config
+            # trap (the dense backend raises for the same noise)
+            raise ValueError(
+                "a drafter was given but spec_k == 0 keeps speculation "
+                "off; pass spec_k >= 1 to enable it"
+            )
+        self.drafter = (
+            drafter if drafter is not None else PromptLookupDrafter()
+        ) if self.spec_k else None
         # per-tick prefill token budget: how much admission work may
         # ride between two decode chunks.  The default matches one
         # decode chunk's per-row depth (admit_every steps) in tokens —
@@ -1195,6 +1354,29 @@ class PagedDecodeEngine(DecodeEngine):
         self._m_prefix_evictions = observability.counter(
             "znicz_serve_prefix_evictions_total",
             "cached blocks evicted to satisfy allocation pressure",
+        )
+        # speculative decoding tallies (zero and silent while spec is
+        # off; the registry series are process-wide get-or-create)
+        self._n_spec_drafted = 0
+        self._n_spec_accepted = 0
+        self._n_spec_rejected = 0
+        self._n_verify_steps = 0
+        self._m_spec_drafted = observability.counter(
+            "znicz_serve_spec_drafted_total",
+            "draft tokens proposed to the speculative verifier",
+        )
+        self._m_spec_accepted = observability.counter(
+            "znicz_serve_spec_accepted_total",
+            "draft tokens the speculative verifier accepted",
+        )
+        self._m_spec_rejected = observability.counter(
+            "znicz_serve_spec_rejected_total",
+            "draft tokens rejected and rolled back (table truncate)",
+        )
+        self._m_spec_accept_len = observability.histogram(
+            "znicz_serve_spec_accept_length",
+            "accepted draft tokens per row per verify step",
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0),
         )
         self._update_pool_gauges()
 
@@ -1728,29 +1910,28 @@ class PagedDecodeEngine(DecodeEngine):
     def _has_work(self) -> bool:
         return bool(self._queue) or self.active > 0 or self.prefilling > 0
 
-    def _run_chunk(self) -> None:
-        faults.fire("engine.decode_step")
-        # lazy per-chunk allocation, oldest first: each decoding row
-        # gets blocks covering the positions THIS chunk can write
-        # (min(chunk, remaining) steps) — never the whole budget up
-        # front; exhaustion preempts the youngest occupant
+    def _grow_for_chunk(self, steps_for) -> bool:
+        """Pre-chunk allocation + write guard, oldest first: each
+        decoding row gets blocks covering the ``steps_for(slot)``
+        positions the coming chunk may write — never the whole budget
+        up front; exhaustion preempts the youngest occupant.  A write
+        must never land in a shared/cached block: COW-split (with copy
+        — the block holds earlier positions' live K/V) any write-range
+        block still shared.  Structurally unreachable under
+        block-aligned sharing + publish-at-retire (mapped blocks are
+        full, writes land past them), but the guard keeps the invariant
+        under ANY future publish policy.  Returns False when pressure
+        preempted every decoder."""
         for slot in self._slots_by_age():
             st = self._slots[slot]
             if st is None or st["mode"] != "decode":
                 continue
-            steps = min(self.admit_every, int(self._remaining[slot]))
             p0 = int(self._pos[slot])
-            last_pos = p0 + max(steps - 1, 0)
+            last_pos = p0 + max(int(steps_for(slot)) - 1, 0)
             if not self._ensure_blocks(
                 slot, last_pos // self.block_size + 1
             ):
                 continue  # starved AND youngest: requeued itself
-            # a decode write must never land in a shared/cached block:
-            # COW-split (with copy — the block holds earlier positions'
-            # live K/V) any write-range block still shared.  Structurally
-            # unreachable under block-aligned sharing + publish-at-retire
-            # (mapped blocks are full, decode writes past them), but the
-            # guard keeps the invariant under ANY future publish policy.
             for j in range(
                 p0 // self.block_size, last_pos // self.block_size + 1
             ):
@@ -1758,16 +1939,13 @@ class PagedDecodeEngine(DecodeEngine):
                     break  # a COW allocation preempted this very row
                 if not self._cow_split(slot, j, copy=True):
                     break
-        if not self.active:
-            return  # allocation pressure preempted every decoder
-        self._peak_active = max(self._peak_active, self.active)
-        # decode WINDOW: the gather spans only the blocks active rows
-        # actually hold (rounded up a x2 rung so the compiled-variant
-        # count stays logarithmic), not the full T_max-wide table — with
-        # paged KV, T_max stops bounding per-step attention cost too.
-        # Allocation above already covers this chunk's growth, so the
-        # window cannot be outrun mid-chunk; retired/idle rows were
-        # zeroed and write to the null block regardless.
+        return self.active > 0
+
+    def _decode_window(self) -> int:
+        """The decode/verify gather WINDOW: the x2 rung covering the
+        blocks active rows actually hold — the compiled-variant count
+        stays logarithmic and short requests never pay ``T_max``-wide
+        attention (docs/SERVING.md)."""
         need = max(
             (len(self._row_blocks[i]) for i, s in enumerate(self._slots)
              if s is not None and s["mode"] == "decode"),
@@ -1776,7 +1954,189 @@ class PagedDecodeEngine(DecodeEngine):
         window = 1
         while window < need:
             window *= 2
-        window = min(window, self.blocks_per_row)
+        return min(window, self.blocks_per_row)
+
+    # -- speculative decoding: draft -> verify -> accept -> rollback ------
+
+    def _draft_pending(self) -> Dict[int, np.ndarray]:
+        """One drafting pass over the decoding rows: each row's drafter
+        context is its OWN prompt plus everything it has emitted (so
+        self-repeating generations draft well, not just repetitive
+        prompts), clamped so accepted drafts can never outrun the
+        row's remaining budget.  Returns {} when NO row drafted —
+        the tick then runs the plain decode chunk instead of paying
+        for an all-pad verify."""
+        drafts: Dict[int, np.ndarray] = {}
+        any_draft = False
+        for slot, st in enumerate(self._slots):
+            if st is None or st["mode"] != "decode":
+                continue
+            req = st["req"]
+            rem = req.max_new_tokens - len(st["emitted"])
+            k = min(self.spec_k, rem - 1)
+            d = np.zeros((0,), np.int32)
+            if k > 0:
+                ctx = np.concatenate(
+                    [req.prompt, np.asarray(st["emitted"], np.int32)]
+                )
+                d = np.asarray(
+                    self.drafter.propose(ctx, k), np.int32
+                ).reshape(-1)[:k]
+            drafts[slot] = d
+            any_draft = any_draft or d.size > 0
+        return drafts if any_draft else {}
+
+    def _verify_chunk(self, drafts: Dict[int, np.ndarray]) -> None:
+        """One speculative tick: pack every decoding row's last token +
+        drafted continuation into a [B, W] verify batch (W = the
+        drafted max snapped UP the ``spec_buckets`` ladder — accepted
+        and drafted lengths are traced, so no stream ever compiles a
+        program per length), run ONE bucketed verify program, emit each
+        row's longest agreeing prefix plus the bonus token, and ROLL
+        BACK the rest by truncating the block table — refcounts reclaim
+        the rejected blocks, no copies (docs/SERVING.md "Speculative
+        decoding")."""
+        w = bucket_for(
+            max(d.size for d in drafts.values()) + 1, self.spec_buckets
+        )
+        b = self.batch_size
+        tokens = np.full((b, w), self.pad_id, np.int32)
+        n_write = np.zeros((b,), np.int32)
+        draft_len = np.zeros((b,), np.int32)
+        for slot, d in drafts.items():
+            st = self._slots[slot]
+            req = st["req"]
+            rem = req.max_new_tokens - len(st["emitted"])
+            dl = min(d.size, w - 1, max(rem - 1, 0))
+            tokens[slot, 0] = self._tok[slot]
+            tokens[slot, 1:1 + dl] = d[:dl]
+            draft_len[slot] = dl
+            # only positions 0..dl are ever READ back (t0 + accepted
+            # drafts; the bonus token's K/V is the next tick's write):
+            # masking the bucket pad in-program both avoids garbage
+            # writes and keeps _grow_for_chunk from allocating — and
+            # possibly preempting a younger row for — blocks that this
+            # same tick's rollback would hand straight back
+            n_write[slot] = dl + 1
+        if not self._grow_for_chunk(lambda slot: int(n_write[slot])):
+            return  # allocation pressure preempted every decoder
+        self._peak_active = max(self._peak_active, self.active)
+        window = self._decode_window()
+        residents = [
+            s["req"] for s in self._slots
+            if s is not None and s["mode"] == "decode"
+        ]
+        t0 = time.perf_counter()
+        with self.timer.phase(
+            "verify", active=self.active, width=w,
+            **self._decode_trace_args(residents),
+        ):
+            rng = jax.random.fold_in(
+                self._rng, 1 << 20 | self._chunk_idx
+            )
+            self._chunk_idx += 1
+            greedy, top_k, nucleus = self._structure
+            pools, out, n_acc = self._timed_program(
+                ("spec_verify", w, self.batch_size, window,
+                 self._structure),
+                _paged_verify_prog,
+                self.params, self._pools,
+                jnp.asarray(self._tables[:, :window]),
+                jnp.asarray(tokens), jnp.asarray(self._pos),
+                jnp.asarray(self._start), jnp.asarray(self._done),
+                jnp.asarray(n_write), jnp.asarray(draft_len),
+                self._temperature, self._top_p, rng,
+                width=w, block_size=self.block_size,
+                n_heads=self.n_heads, greedy=greedy, top_k=top_k,
+                nucleus=nucleus, moe_top_k=self.moe_top_k,
+                moe_dispatch=self.moe_dispatch,
+            )
+            self._pools = pools
+            out = np.asarray(out)
+            n_acc = np.asarray(n_acc)
+        dt = time.perf_counter() - t0
+        self._n_verify_steps += 1
+        for r in residents:
+            r.timings.decode_s += dt
+        for slot, st in enumerate(self._slots):
+            # rows preempted during allocation never reached the
+            # program (their writes were masked via the done flag)
+            if st is None or st["mode"] != "decode":
+                continue
+            req, emitted = st["req"], st["emitted"]
+            dl = int(draft_len[slot])
+            na = min(int(n_acc[slot]), dl)
+            reason = None
+            appended = 0
+            for t in out[slot, :na + 1]:
+                emitted.append(int(t))
+                appended += 1
+                if int(t) == self.eos_id:
+                    reason = "eos"
+                    break
+                if len(emitted) >= req.max_new_tokens:
+                    reason = "budget"
+                    break
+            self._n_spec_drafted += dl
+            self._n_spec_accepted += na
+            self._n_spec_rejected += dl - na
+            req.timings.spec_drafted += dl
+            req.timings.spec_accepted += na
+            if dl:
+                self._m_spec_drafted.inc(dl)
+                self._m_spec_accepted.inc(na)
+                self._m_spec_rejected.inc(dl - na)
+                self._m_spec_accept_len.observe(float(na))
+            if reason is not None:
+                self._retire_slot(slot, emitted, reason)
+            else:
+                self._tok[slot] = emitted[-1]
+                self._pos[slot] = int(self._pos[slot]) + appended
+                self._remaining[slot] = req.max_new_tokens - len(emitted)
+                self._truncate_row(slot)
+        self._m_active.set(self.active)
+
+    def _truncate_row(self, slot: int) -> None:
+        """Speculative ROLLBACK: drop the table entries past the last
+        position holding accepted K/V.  The truncated blocks were
+        allocated (private, COW-guarded) for draft positions the
+        verifier rejected — a decref walks each back to the free list
+        (or the cache, had it been shared), so rollback is bookkeeping
+        only: no device copies, no recompute."""
+        row = self._row_blocks[slot]
+        keep = (int(self._pos[slot]) - 1) // self.block_size + 1
+        if len(row) <= keep:
+            return
+        for blk in reversed(row[keep:]):
+            self._decref(blk)
+        del row[keep:]
+        self._tables[slot, keep:] = NULL_BLOCK
+        self._update_pool_gauges()
+
+    def _run_chunk(self) -> None:
+        faults.fire("engine.decode_step")
+        if self.spec_k:
+            drafts = self._draft_pending()
+            if drafts:
+                self._verify_chunk(drafts)
+                return
+            # no row produced a draft this tick: fall through to the
+            # plain (already-compiled) decode chunk — an unpredictable
+            # stream pays ZERO verify overhead and ZERO new programs
+        # lazy per-chunk allocation, oldest first: each decoding row
+        # gets blocks covering the positions THIS chunk can write
+        # (min(chunk, remaining) steps) — never the whole budget up
+        # front; exhaustion preempts the youngest occupant
+        if not self._grow_for_chunk(
+            lambda slot: min(self.admit_every, int(self._remaining[slot]))
+        ):
+            return  # allocation pressure preempted every decoder
+        self._peak_active = max(self._peak_active, self.active)
+        # decode WINDOW (:meth:`_decode_window`): allocation above
+        # already covers this chunk's growth, so the window cannot be
+        # outrun mid-chunk; retired/idle rows were zeroed and write to
+        # the null block regardless.
+        window = self._decode_window()
         residents = [
             s["req"] for s in self._slots
             if s is not None and s["mode"] == "decode"
@@ -1850,6 +2210,7 @@ class PagedDecodeEngine(DecodeEngine):
             "prefill_jit_entries": _paged_prefill_prog._cache_size(),
             "paged_chunk_jit_entries": _paged_decode_chunk._cache_size(),
             "cow_jit_entries": _cow_copy_prog._cache_size(),
+            "spec_verify_jit_entries": _paged_verify_prog._cache_size(),
         }
 
     @property
@@ -1860,6 +2221,25 @@ class PagedDecodeEngine(DecodeEngine):
         return (len(self._free) + len(self._lru)) / max(
             self.usable_blocks, 1
         )
+
+    def spec_stats(self) -> Dict:
+        """The live speculative-decoding report (``stats()["spec"]``):
+        drafted/accepted/rejected token tallies, verify-step count and
+        the acceptance rate — accepted drafts over drafted, the single
+        number that says whether speculation is paying on this
+        stream."""
+        return {
+            "enabled": bool(self.spec_k),
+            "k": self.spec_k,
+            "buckets": list(self.spec_buckets),
+            "drafted": self._n_spec_drafted,
+            "accepted": self._n_spec_accepted,
+            "rejected": self._n_spec_rejected,
+            "verify_steps": self._n_verify_steps,
+            "acceptance_rate": round(
+                self._n_spec_accepted / max(self._n_spec_drafted, 1), 4
+            ),
+        }
 
     def stats(self) -> Dict:
         """Adds the block-pool + prefix-cache view to the base report.

@@ -24,6 +24,15 @@ class RequestTooLargeError(ValueError):
     a bigger pool."""
 
 
+class SpeculationUnsupportedError(ValueError):
+    """Speculative decoding was configured on a backend that cannot
+    roll rejected tokens back — a CONFIG error, raised at engine
+    construction, never per request.  Subclasses ``ValueError`` (the
+    same contract as :class:`RequestTooLargeError`): callers that
+    validate engine config with a bare ``except ValueError`` keep
+    working, typed callers can route it specifically."""
+
+
 class EngineClosedError(RuntimeError):
     """Submitted to a closed (or closing) front door / engine — the
     graceful-shutdown path; retry against a live replica."""

@@ -13,6 +13,14 @@ revived as a serving concern):
   (admit → prefill chunk → decode chunk — the same programs ``run()``
   uses; the front door adds ZERO compiled programs).  All engine state
   stays single-threaded; callers talk to it through queues.
+  Speculative decoding rides this unchanged: a paged engine factory
+  built with ``spec_k > 0`` drafts/verifies inside the same tick (a
+  watchdog restart rebuilds from the factory, so the spec config — and
+  the warm verify programs — survive a crash), every completion's
+  ``timings`` carries ``spec_drafted``/``spec_accepted``, and the dense
+  backend's factory fails construction with the typed
+  :class:`~znicz_tpu.services.errors.SpeculationUnsupportedError`
+  before the door ever starts.
 * **submit() → handle**: validation runs single-flight BEFORE enqueue
   (:class:`RequestTooLargeError` — a request that can never fit is
   refused at the door, not after queueing).  The handle streams tokens

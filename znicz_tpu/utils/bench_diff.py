@@ -13,10 +13,11 @@ Accepted inputs (both sides independently):
   and non-numeric fields are skipped).
 
 Direction is inferred per metric name — throughput-shaped names
-(``*_per_sec``, ``*_rps``, ``*_hit_rate``, ``mfu``...) regress when
-they DROP; latency/cost-shaped names (``*ttft*``, ``*latency*``,
-``*_ms``, ``*compile*``, ``preemptions``, ``retries``, ``failed``...)
-regress when they RISE.  Override per metric with ``--lower NAME`` /
+(``*_per_sec``, ``*_rps``, ``*_hit_rate``, ``*_vs_baseline``,
+``*_acceptance_rate``, ``mfu``...) regress when they DROP;
+latency/cost-shaped names (``*ttft*``, ``*latency*``, ``*_ms``,
+``*compile*``, ``preemptions``, ``retries``, ``failed``...) regress
+when they RISE.  Override per metric with ``--lower NAME`` /
 ``--higher NAME``; scope with ``--only PREFIX``; tune with
 ``--threshold FRAC`` (default 0.10 — a 10% move).
 
@@ -42,7 +43,7 @@ _LOWER_MARKERS = (
 )
 _HIGHER_MARKERS = (
     "per_sec", "per_s", "rps", "hit_rate", "mfu", "concurrency",
-    "vs_dense", "vs_baseline",
+    "vs_dense", "vs_baseline", "acceptance_rate",
 )
 
 # fields of a record that are bookkeeping, not comparable metrics

@@ -351,24 +351,151 @@ def paged_decode_step(
 
 
 def _embed_rows(embed, token, pos, start=None):
-    """Token + positional embedding for one token per row at PER-ROW
-    absolute positions ``pos`` [B] (the paged decode twin of
-    :func:`_embed_at`, which takes one shared offset).  With ``start``
-    the position index is row-relative, same left-padding contract."""
-    rel = pos if start is None else pos - start
+    """Token + positional embedding at PER-ROW absolute positions (the
+    paged twin of :func:`_embed_at`, which takes one shared offset).
+    ``token``/``pos`` are ``[B]`` (one decode step) or ``[B, W]`` (a
+    speculative verify chunk — W consecutive positions per row).  With
+    ``start`` the position index is row-relative, same left-padding
+    contract."""
+    if token.ndim == 1:
+        token = token[:, None]
+        pos = pos[:, None]
+    rel = pos if start is None else pos - start[:, None]
     rel = jnp.clip(rel, 0, embed["pos"].shape[0] - 1)
-    return embed["embed"][token[:, None]] + embed["pos"][rel[:, None]]
+    return embed["embed"][token] + embed["pos"][rel]
 
 
-def _sample(logits, key, temperature, top_k, nucleus, top_p):
-    """Greedy (``greedy`` static) or temperature sampling, optionally
-    truncated to the ``top_k`` highest logits and/or the ``top_p``
+def paged_verify_chunk(
+    params, pools, tables, tokens, pos, *, n_heads, block_size,
+    start=None, write_mask=None, moe_top_k=1, moe_dispatch="dense",
+):
+    """Score W tokens per row at per-row positions ``pos .. pos+W-1``
+    through the paged tower in ONE forward pass — the speculative-
+    decoding VERIFY primitive; returns ``(pools, logits [B, W, vocab])``
+    where ``logits[:, i]`` is the next-token distribution AFTER input
+    token ``i``.
+
+    ``tokens`` is ``[B, W]``: each row's current last sampled token
+    followed by its drafted continuation (padded past the draft).  Each
+    position writes its K/V at ``(tables[b, (pos_b+i)//bs],
+    (pos_b+i)%bs)`` before attention gathers through the table, so a
+    query at position ``pos_b+i`` attends exactly what ``i`` sequential
+    :func:`paged_decode_step` calls would have seen — same masked
+    stable-softmax numerics, same validity-by-absolute-index contract,
+    which is what makes greedy speculative decode token-identical to
+    non-speculative decode.  ``write_mask`` ``[B, W]`` routes masked
+    positions (done rows, positions past the row's budget — whose
+    table lookup may even fall off the windowed table) to the reserved
+    ``NULL_BLOCK``.  Rejected positions DO leave garbage K/V behind;
+    that is safe for the same reason prefill's right-pad is: validity
+    is by absolute index, and the next step's writes overwrite every
+    garbage position before any query can reach it — the engine
+    additionally truncates the block table back to the accepted prefix
+    (rollback is bookkeeping, not copies).  W, like the chunk length in
+    :func:`paged_prefill_chunk`, is a compile-time shape: the engine
+    snaps it to a small bucket ladder so accepted/drafted lengths are
+    traced operands and no accepted length ever compiles a new
+    program."""
+    b, w = tokens.shape
+    rows = jnp.arange(b)[:, None]
+    pos_w = pos[:, None] + jnp.arange(w)[None, :]  # [B, W]
+    blk = tables[rows, pos_w // block_size]
+    if write_mask is not None:
+        blk = jnp.where(write_mask, blk, NULL_BLOCK)
+    slot = pos_w % block_size
+    x = _embed_rows(params[0], tokens, pos_w, start)
+
+    def write(pool, new):
+        return pool.at[blk, slot].set(new)
+
+    new_pools = []
+    for block, pool in zip(params[1:-1], pools):
+        x, pool = _paged_block_step(
+            block, x, pool, write, tables, pos_w, n_heads=n_heads,
+            block_size=block_size, start=start, moe_top_k=moe_top_k,
+            moe_dispatch=moe_dispatch,
+        )
+        new_pools.append(pool)
+    return new_pools, x @ params[-1]["head"]
+
+
+# ---------------------------------------------------------------------------
+# Speculative drafting (Leviathan et al. 2023 lineage).  The drafter is
+# a tiny HOST-side interface — ``propose(context, k) -> up to k token
+# ids`` — so the paged engine's verify path is agnostic to where the
+# guesses come from: prompt-lookup below costs zero extra weights; a
+# draft-model drafter (a small transformer_lm sharing the target's
+# tokenizer) plugs into the same hook.
+
+# verify-width (k+1) bucket ladder: drafted lengths snap UP a rung so
+# the verify program compiles once per rung, never per accepted length
+DEFAULT_SPEC_BUCKETS = (2, 4, 8)
+
+
+class PromptLookupDrafter:
+    """Prompt-lookup / n-gram drafting (Saxena 2023): propose the
+    continuation of the MOST RECENT earlier occurrence of the context's
+    final n-gram, longest n first.  The context is the row's own prompt
+    plus everything it has emitted — repetitive prompts (retrieval,
+    code, multi-turn chat) and self-repeating generations both draft
+    well, and the proposal costs a few numpy comparisons, no weights.
+
+    Duck-typed drafter contract (what :class:`~znicz_tpu.services
+    .engine.PagedDecodeEngine` calls every speculative tick, per
+    decoding row): ``propose(context, k)`` takes the 1-D int32 token
+    context and returns UP TO ``k`` proposed next tokens (empty when it
+    has no confident guess — the engine then falls back to the plain
+    decode chunk, so an unpredictable stream never pays verify
+    overhead).  ``ngram_min=2`` by default: a 1-gram match is noise on
+    most streams, and a wasted verify pass costs real tower compute
+    where an abstained tick costs nothing."""
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 2):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError(
+                f"want 1 <= ngram_min <= ngram_max; got "
+                f"{ngram_min}, {ngram_max}"
+            )
+        self.ngram_max = int(ngram_max)
+        self.ngram_min = int(ngram_min)
+
+    def propose(self, context, k: int) -> np.ndarray:
+        ctx = np.asarray(context, np.int32).reshape(-1)
+        if k <= 0:
+            return np.zeros((0,), np.int32)
+        for n in range(self.ngram_max, self.ngram_min - 1, -1):
+            if ctx.size <= n:
+                continue
+            pattern = ctx[-n:]
+            win = np.lib.stride_tricks.sliding_window_view(ctx, n)
+            hits = np.nonzero((win == pattern).all(axis=1))[0]
+            # need at least one continuation token; this also drops the
+            # terminal self-match (the pattern matching itself)
+            hits = hits[hits + n < ctx.size]
+            if hits.size:
+                # prefer the LATEST occurrence with k continuation
+                # tokens available: inside a repeated run the most
+                # recent match sits one step from the end and could
+                # only ever propose a single token, while an earlier
+                # occurrence of the same pattern carries the whole
+                # periodic continuation (the continuation may overlap
+                # the context tail — that IS the periodic guess)
+                full = hits[hits + n + int(k) <= ctx.size]
+                i = int(full[-1] if full.size else hits[-1])
+                return ctx[i + n: i + n + int(k)].copy()
+        return np.zeros((0,), np.int32)
+
+
+def _filter_logits(logits, temperature, top_k, nucleus, top_p):
+    """The sampling truncation pipeline: temperature scaling, optional
+    ``top_k`` cut (lax.top_k wants a static k) and optional ``top_p``
     nucleus (smallest prefix of the sorted distribution with cumulative
-    probability >= top_p; the argmax token is always kept).  Only the
-    STRUCTURAL knobs (top_k — lax.top_k wants a static k — and the
-    nucleus on/off flag) are trace-time constants; ``temperature`` and
-    ``top_p`` are traced operands, so sweeping them never recompiles
-    the decode program."""
+    probability >= top_p; the argmax token is always kept).  Operates
+    on the LAST axis, so it serves ``[B, vocab]`` decode logits and
+    ``[B, W, vocab]`` speculative verify logits alike — the ONE owner
+    of the truncation semantics, shared by :func:`_sample` and the
+    verify program's rejection sampler (the accept probability must be
+    computed on exactly the distribution :func:`_sample` draws from)."""
     logits = logits / temperature
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
@@ -382,7 +509,19 @@ def _sample(logits, key, temperature, top_k, nucleus, top_p):
             jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits >= thr, logits, -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def _sample(logits, key, temperature, top_k, nucleus, top_p):
+    """Greedy (``greedy`` static) or temperature sampling over the
+    truncated distribution (:func:`_filter_logits`).  Only the
+    STRUCTURAL knobs (top_k and the nucleus on/off flag) are trace-time
+    constants; ``temperature`` and ``top_p`` are traced operands, so
+    sweeping them never recompiles the decode program."""
+    return jax.random.categorical(
+        key, _filter_logits(logits, temperature, top_k, nucleus, top_p),
+        axis=-1,
+    ).astype(jnp.int32)
 
 
 def _check_sampling_args(params, temperature, top_k, top_p, rng, eos_id):
