@@ -67,8 +67,14 @@ def _metrics_snapshot() -> dict:
         from znicz_tpu.observability import device, get_registry
         from znicz_tpu.observability import slo as slo_mod
 
+        from znicz_tpu.observability.pipeline import PipelineAttribution
+
         snap = get_registry().snapshot()
         snap["slo"] = slo_mod.lifetime_snapshot()
+        # the input-pipeline attribution verdict over the whole round
+        # ({"type": "pipeline"} — self-describing like "slo", skipped
+        # by the aggregator's family merge)
+        snap["pipeline"] = PipelineAttribution.from_registry().attribution()
         ledger = device.ledger_snapshot()
         snap["programs"] = {
             "type": "programs",
@@ -306,10 +312,10 @@ def _sec_alexnet(ctx):
     mask = jnp.asarray(mb.mask)
 
     # compile + warmup (steps carry the on-device metric accumulator)
-    state, acc = wf._train_step(
+    state, acc, _w = wf._train_step(
         wf.state, x, y, mask, 1.0, wf._acc_init(), wf._ctx
     )
-    state, acc = wf._train_step(state, x, y, mask, 1.0, acc, wf._ctx)
+    state, acc, _w = wf._train_step(state, x, y, mask, 1.0, acc, wf._ctx)
     jax.block_until_ready(acc)
     print(f"setup+compile {time.time()-t_setup:.1f}s", file=sys.stderr)
 
@@ -320,7 +326,7 @@ def _sec_alexnet(ctx):
         nonlocal state, acc
         t0 = time.time()
         for _ in range(n):
-            state, acc = wf._train_step(state, x, y, mask, 1.0, acc, wf._ctx)
+            state, acc, _w = wf._train_step(state, x, y, mask, 1.0, acc, wf._ctx)
         # A value fetch (not just block_until_ready) is the only reliable
         # full-pipeline sync under remote-relay transports.
         float(jax.device_get(acc)[0])
@@ -684,6 +690,88 @@ def _sec_mnist(ctx):
             "mnist_step_method": "fori_loop_1000_min4_discard1",
             "mnist_epoch_scan_images_per_sec": round(mnist_epoch_scan, 1),
             "mnist_epoch_step_images_per_sec": round(mnist_epoch_step, 1),
+        }
+    ]
+
+
+@_section("mnist_stream")
+def _sec_mnist_stream(ctx):
+    # streaming-input training: u8 minibatches cross host->device every
+    # step (stepwise dispatch + the prefetch thread) — the regime of
+    # ROADMAP's 100x gap.  Beyond the throughput number, this section
+    # carries the PIPELINE ATTRIBUTION verdict (where each step's wall
+    # went: compute / prefetch-wait / H2D / other) — the measurement the
+    # streaming-rebuild rung is judged with, identical to what
+    # tools/znicz-doctor prints from this run's metrics.prom.
+    import numpy as np
+
+    from znicz_tpu.loader.fullbatch import FullBatchLoader
+    from znicz_tpu.observability import PipelineAttribution
+    from znicz_tpu.observability import pipeline as pipeline_obs
+    from znicz_tpu.workflow import StandardWorkflow
+
+    m_imgs = ctx.get("mnist_imgs")
+    if m_imgs is None:
+        gen = np.random.default_rng(1)
+        m_imgs = gen.integers(0, 256, (12800, 28, 28, 1), dtype=np.uint8)
+    m_labels = (
+        np.random.default_rng(2).integers(0, 10, len(m_imgs)).astype(np.int32)
+    )
+    ld = FullBatchLoader(
+        {"train": m_imgs},
+        {"train": m_labels},
+        minibatch_size=128,
+        normalization="range",
+        normalization_kwargs={"scale": 255.0, "shift": -0.5},
+        device_convert=True,
+        device_resident=False,
+    )
+    swf = StandardWorkflow(
+        ld,
+        [{"type": "all2all_tanh", "->": {"output_sample_shape": 256}},
+         {"type": "softmax", "->": {"output_sample_shape": 10}}],
+        decision_config={"max_epochs": 10000},
+        default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+        epoch_dispatch="step",
+    )
+    swf.initialize(seed=3)
+    swf.run_epoch()  # compile + warmup
+    # steady-state attribution window: exclude the compile epoch's
+    # stall from the fractions the record reports
+    pipeline_obs.reset_window()
+    n_ep = 2
+    t0 = time.time()
+    for _ in range(n_ep):
+        swf.run_epoch()
+    stream_rate = n_ep * len(m_imgs) / (time.time() - t0)
+    att = PipelineAttribution.from_registry().attribution()
+    fr = att.get("fractions", {})
+    print(
+        f"mnist stream: {stream_rate:.0f} img/s; {att.get('verdict')} "
+        f"(compute {fr.get('compute', 0):.2f}, prefetch-wait "
+        f"{fr.get('prefetch_wait', 0):.2f}, h2d {fr.get('h2d', 0):.2f}, "
+        f"other {fr.get('other', 0):.2f}); "
+        f"H2D {(att.get('h2d_bytes_per_second') or 0) / 1e6:.1f} MB/s",
+        file=sys.stderr,
+    )
+    return [
+        {
+            "metric": "mnist_stream_images_per_sec",
+            "value": round(stream_rate, 1),
+            "unit": "images/sec",
+            # top-level numerics: znicz-bench-diff lifts these into the
+            # round diff (*_bound_frac lower-better, *_bytes_per_second
+            # higher-better)
+            "train_input_bound_frac": float(
+                att.get("input_bound_frac", 0.0)
+            ),
+            "train_h2d_bytes_per_second": float(
+                att.get("h2d_bytes_per_second") or 0.0
+            ),
+            # the full self-describing attribution record ({"type":
+            # "pipeline"} — skipped by metric-family walkers, safe
+            # through the aggregator round trip like the programs entry)
+            "pipeline": att,
         }
     ]
 

@@ -377,15 +377,16 @@ class TestPipelineSpans:
         assert counts["snapshot/save"] == 1
         assert counts["snapshot/gather"] == 1
         assert counts["snapshot/write"] == 1
-        # one produce span per item + the final end-of-stream pull
-        assert counts["loader/prefetch_produce"] == 6
+        # the produce span is stage-split (PR 13): one fetch span per
+        # item + the final end-of-stream pull
+        assert counts["loader/fetch"] == 6
         # gather/write nest inside the save span
         write = next(e for e in events if e["name"] == "snapshot/write")
         assert write["args"]["parent"] == "snapshot/save"
         # producer spans carry the WORKER thread's tid — their own
         # Perfetto track, next to (not under) the consumer's spans
         prod = [
-            e for e in events if e["name"] == "loader/prefetch_produce"
+            e for e in events if e["name"] == "loader/fetch"
         ]
         assert all(e["tid"] != threading.get_ident() for e in prod)
 

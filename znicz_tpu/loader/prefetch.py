@@ -6,6 +6,18 @@ hot loop is a single host thread, so decode/gather work (image files, u8
 conversion) would serialize with device dispatch.  ``prefetch`` runs the
 loader's generator in a worker thread with a small bounded queue — identical
 yield order and PRNG draw sequence, overlapped with compute.
+
+The producer is stage-instrumented (docs/OBSERVABILITY.md "Training
+observability"): each item's **fetch** (materializing one batch from the
+upstream iterable), optional **host transform** (a ``transform`` callable
+run on the producer thread — decode/augment, or the workflow's device
+placement) and **enqueue** (blocked handing the batch over) observe into
+``znicz_pipeline_stage_seconds{stage}`` and emit matching tracer spans, so
+"producer slow" (long fetch/transform) and "producer starved" (long
+enqueue — the consumer is the bottleneck and the queue stayed full,
+counted by ``znicz_prefetch_queue_full_total``) are distinguishable in
+one capture.  The ``loader.fetch`` fault point fires inside the timed
+fetch, making a slow producer a deterministic CI fixture.
 """
 
 from __future__ import annotations
@@ -13,50 +25,100 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Iterable, Iterator, TypeVar
+from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
 from znicz_tpu import observability
+from znicz_tpu.observability import pipeline as _pipeline
+from znicz_tpu.utils import faults
 
 T = TypeVar("T")
 
 _SENTINEL = object()
 
 
-def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
+def prefetch(
+    iterable: Iterable[T],
+    depth: int = 2,
+    *,
+    transform: Optional[Callable[[T], T]] = None,
+    transform_stage: Optional[str] = _pipeline.STAGE_TRANSFORM,
+) -> Iterator[T]:
     """Yield from ``iterable``, produced ``depth`` items ahead in a thread.
 
-    Exceptions in the producer re-raise at the consumer's next pull.  If the
-    consumer abandons the iterator (exception mid-epoch, interrupt), closing
-    the generator signals the worker to stop — no thread or queued batches
-    leak.
+    ``transform`` (optional) is applied to each item ON the producer
+    thread — host decode/augment work, or the workflow's device-placement
+    closure — timed as the ``transform_stage`` pipeline stage (pass
+    ``transform_stage=None`` when the callable owns its own
+    instrumentation, e.g. an :class:`~znicz_tpu.observability.H2DProbe`).
+
+    Exceptions in the producer (fetch or transform) re-raise at the
+    consumer's next pull.  If the consumer abandons the iterator
+    (exception mid-epoch, interrupt), closing the generator signals the
+    worker to stop — no thread or queued batches leak.
     """
     q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
     stop = threading.Event()
     error: list = []
 
+    # per-stage producer telemetry: each span is on the LOADER's own
+    # thread track in Perfetto, so producer stalls line up against the
+    # consumer's znicz_prefetch_wait_seconds histogram and the
+    # train/serve spans they starve.  No-op span cost when the tracer
+    # is idle; one histogram observe per stage per item otherwise.
+    stage_hist = _pipeline.stage_seconds()
+    queue_full = observability.counter(
+        _pipeline.QUEUE_FULL_METRIC,
+        "items whose producer-side enqueue found the prefetch queue "
+        "full at least once (depth exhaustion: the consumer, not the "
+        "producer, is behind)",
+    )
+
     def worker():
-        # producer-side spans (ROADMAP observability next-rung): each
-        # span is the time the LOADER spent materializing one batch —
-        # on its own thread track in Perfetto, so loader stalls line up
-        # against the consumer's znicz_prefetch_wait_seconds histogram
-        # and the train/serve spans they starve.  No-op cost when the
-        # tracer is idle.
         tracer = observability.get_tracer()
         try:
             it = iter(iterable)
             while True:
-                with tracer.span("loader/prefetch_produce"):
+                t0 = time.perf_counter()
+                with tracer.span("loader/fetch"):
+                    # the fault fires INSIDE the timed window, so an
+                    # injected delay reads as a slow producer to the
+                    # attribution (the input-bound CI fixture)
+                    faults.fire("loader.fetch")
                     item = next(it, _SENTINEL)
+                stage_hist.labels(stage=_pipeline.STAGE_FETCH).observe(
+                    time.perf_counter() - t0
+                )
                 if item is _SENTINEL:
                     break
+                if transform is not None:
+                    if transform_stage is None:
+                        item = transform(item)
+                    else:
+                        t0 = time.perf_counter()
+                        with tracer.span(f"loader/{transform_stage}"):
+                            item = transform(item)
+                        stage_hist.labels(stage=transform_stage).observe(
+                            time.perf_counter() - t0
+                        )
                 # bounded put that gives up when the consumer went away
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    # polling control flow, not a swallowed failure
-                    except queue.Full:  # znicz-check: disable=ZNC008
-                        continue
+                t0 = time.perf_counter()
+                try:
+                    # non-blocking first attempt: ANY fullness counts as
+                    # a depth-exhaustion stall, even one shorter than
+                    # the polling timeout below
+                    q.put_nowait(item)
+                except queue.Full:  # znicz-check: disable=ZNC008
+                    queue_full.inc()
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        # polling control flow, not a swallowed failure
+                        except queue.Full:  # znicz-check: disable=ZNC008
+                            continue
+                stage_hist.labels(stage=_pipeline.STAGE_ENQUEUE).observe(
+                    time.perf_counter() - t0
+                )
                 if stop.is_set():
                     return
         except BaseException as e:  # noqa: BLE001 — must cross threads

@@ -30,6 +30,14 @@ generate serve-cache counters, StatusWriter's timing dict):
 * **SLO monitoring** (:mod:`slo`) — rolling-window p50/p95/p99 and
   multi-window burn rates over declared targets (``/slo``,
   ``tools/znicz-slo``).
+* **Pipeline attribution** (:mod:`pipeline`) — per-stage input-pipeline
+  timings (fetch / host_transform / h2d / enqueue), the live H2D
+  bandwidth gauge, and the step-wall decomposition behind
+  ``tools/znicz-doctor``.
+* **Step anomaly flight recorder** (:mod:`anomaly`) — typed per-step
+  verdicts (non-finite loss/grad, loss spikes, step-time regressions)
+  with a bounded ring of last-K-steps snapshots, surfaced through
+  ``status.json`` / ``/metrics`` / the aggregator.
 
 Convenience module-level ``counter``/``gauge``/``histogram`` operate on
 the default registry; see docs/OBSERVABILITY.md for the metric catalog.
@@ -47,8 +55,16 @@ from znicz_tpu.observability.collector import (  # noqa: F401
     build_collector_server,
 )
 from znicz_tpu.observability import device  # noqa: F401
+from znicz_tpu.observability.anomaly import (  # noqa: F401
+    StepAnomalyDetector,
+)
 from znicz_tpu.observability.phases import PhaseTimer  # noqa: F401
+from znicz_tpu.observability.pipeline import (  # noqa: F401
+    H2DProbe,
+    PipelineAttribution,
+)
 from znicz_tpu.observability.registry import (  # noqa: F401
+    DEFAULT_FRACTION_BUCKETS,
     DEFAULT_TIME_BUCKETS,
     Metric,
     MetricsRegistry,

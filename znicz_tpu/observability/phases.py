@@ -78,6 +78,13 @@ class PhaseTimer:
         out = {}
         for name, (count, total) in self._totals().items():
             base_count, base_total = self._base.get(name, (0, 0.0))
+            if count < base_count:
+                # the family was reset() behind our back (e.g. a
+                # warm-up pipeline.reset_window()): the captured base
+                # is stale — fall back to the fresh series as-is
+                # instead of reporting empty/negative windows forever
+                base_count, base_total = 0, 0.0
+                self._base[name] = (0, 0.0)
             n, s = count - base_count, total - base_total
             if n > 0:
                 out[name] = {

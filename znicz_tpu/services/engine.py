@@ -684,6 +684,27 @@ class DecodeEngine:
             "znicz_serve_ttft_seconds",
             "submit -> first sampled token per request",
         )
+        # per-tick occupancy: what fraction of each engine tick's wall
+        # went to admission/prefill vs the decode chunk vs a spec-verify
+        # chunk — the measured input the spec-aware-SLO-tuning and
+        # scheduling rungs consume (ROADMAP).  Fractions, not seconds:
+        # a tick is the scheduling quantum, so its internal split is
+        # the signal (wall itself rides znicz_serve_phase_seconds)
+        self._m_tick_occ = observability.histogram(
+            "znicz_serve_tick_occupancy",
+            "per-tick fraction of wall spent by phase "
+            "(prefill / decode / spec_verify)",
+            ("phase",),
+            buckets=observability.DEFAULT_FRACTION_BUCKETS,
+        )
+        self._occ_seconds = {
+            "prefill": 0.0, "decode": 0.0, "spec_verify": 0.0,
+        }
+        self._occ_wall = 0.0
+        self._occ_ticks = 0
+        # which kind of chunk the last _run_chunk ran ("decode" or
+        # "spec_verify") — written by the paged subclass's spec path
+        self._last_chunk_kind = "decode"
         self.latency = profiling.LatencyStats(
             observe=self._m_latency.observe
         )
@@ -804,13 +825,64 @@ class DecodeEngine:
         has completed.  Returns this call's completions in retirement
         order (also kept in :attr:`completions` by id)."""
         n0 = len(self._order)
-        while self._has_work():
-            self._admit_pending()
-            self._prefill_tick()
-            if not self.active:
-                continue  # everything admitted retired instantly
-            self._run_chunk()
+        while self.tick():
+            pass
         return self._order[n0:]
+
+    def tick(self) -> bool:
+        """ONE engine tick — admit + prefill, then a decode (or
+        spec-verify) chunk — with the per-phase wall split observed
+        into ``znicz_serve_tick_occupancy{phase}``.  Returns False when
+        there is no work (nothing ran).  Both :meth:`run` and the front
+        door's engine thread drive the engine through this, so the
+        occupancy series is the one truth for tick composition."""
+        if not self._has_work():
+            return False
+        t0 = time.perf_counter()
+        self._admit_pending()
+        self._prefill_tick()
+        t1 = time.perf_counter()
+        chunk_kind = None
+        if self.active:
+            self._last_chunk_kind = "decode"
+            self._run_chunk()
+            chunk_kind = self._last_chunk_kind
+        t2 = time.perf_counter()
+        self._observe_tick(t1 - t0, t2 - t1, chunk_kind)
+        return True
+
+    def _observe_tick(
+        self,
+        prefill_s: float,
+        chunk_s: float,
+        chunk_kind: Optional[str],
+    ) -> None:
+        wall = prefill_s + chunk_s
+        if wall <= 0:
+            return
+        frac = {"prefill": prefill_s / wall}
+        if chunk_kind is not None:
+            frac[chunk_kind] = chunk_s / wall
+        for phase, f in frac.items():
+            self._m_tick_occ.labels(phase=phase).observe(f)
+        self._occ_seconds["prefill"] += prefill_s
+        if chunk_kind is not None:
+            self._occ_seconds[chunk_kind] += chunk_s
+        self._occ_wall += wall
+        self._occ_ticks += 1
+
+    def tick_occupancy(self) -> Dict:
+        """Lifetime tick-composition report (the ``stats()`` entry):
+        tick count, total tick wall, and each phase's fraction of it."""
+        wall = self._occ_wall
+        return {
+            "ticks": self._occ_ticks,
+            "wall_s": round(wall, 6),
+            "frac": {
+                k: round(v / wall, 4) if wall > 0 else 0.0
+                for k, v in self._occ_seconds.items()
+            },
+        }
 
     def _has_work(self) -> bool:
         return bool(self._queue) or self.active > 0
@@ -1114,6 +1186,7 @@ class DecodeEngine:
             "peak_active": self._peak_active,
             "latency": self.latency.summary(),
             "phases": self.timer.summary(),
+            "tick_occupancy": self.tick_occupancy(),
             "spec": self.spec_stats(),
             **self.compile_stats(),
         }
@@ -2118,6 +2191,7 @@ class PagedDecodeEngine(DecodeEngine):
         if self.spec_k:
             drafts = self._draft_pending()
             if drafts:
+                self._last_chunk_kind = "spec_verify"
                 self._verify_chunk(drafts)
                 return
             # no row produced a draft this tick: fall through to the

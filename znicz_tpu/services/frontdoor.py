@@ -716,11 +716,10 @@ class ServingFrontDoor:
                 self._shed_all()
             self._pump_pending()
             eng = self._engine
-            if eng is not None and eng._has_work():
-                eng._admit_pending()
-                eng._prefill_tick()
-                if eng.active:
-                    eng._run_chunk()
+            if eng is not None:
+                # one occupancy-instrumented engine tick (admit +
+                # prefill + decode/verify chunk); no-op without work
+                eng.tick()
             self._stream_and_collect()
             self._publish_gauges()
             if self._slo.maybe_sample():

@@ -99,6 +99,12 @@ class StatusWriter:
             # the whole process-wide metrics registry, embedded so one
             # status.json answers "what is this process doing right now"
             "metrics": get_registry().snapshot(),
+            # flight-recorder readout: the anomaly ring + active flag
+            # (typed verdicts with last-K-steps snapshots) and the
+            # input-pipeline attribution verdict — the same records
+            # znicz-doctor derives from /metrics, epoch-fresh here
+            "anomalies": self._anomalies(workflow),
+            "pipeline": self._attribution(),
         }
         _atomic_write(
             os.path.join(self.directory, "status.json"),
@@ -115,6 +121,33 @@ class StatusWriter:
         if self._pusher is not None:
             # epoch-fresh fleet view; bounded by the pusher's timeout
             self._pusher.push_now()
+
+    @staticmethod
+    def _anomalies(workflow) -> dict:
+        """The workflow's flight-recorder report (empty when the
+        detector is off).  Status must never break training."""
+        detector = getattr(workflow, "anomaly", None)
+        if detector is None:
+            return {"active": False, "total": 0, "ring": []}
+        try:
+            return detector.report()
+        except Exception:
+            logger.debug("anomaly report failed", exc_info=True)
+            return {"active": False, "total": 0, "ring": []}
+
+    @staticmethod
+    def _attribution() -> dict:
+        """Pipeline-attribution verdict over the live registry (the
+        ``{"type": "pipeline"}`` self-describing record)."""
+        try:
+            from znicz_tpu.observability.pipeline import (
+                PipelineAttribution,
+            )
+
+            return PipelineAttribution.from_registry().attribution()
+        except Exception:
+            logger.debug("pipeline attribution failed", exc_info=True)
+            return {"type": "pipeline", "verdict": "error"}
 
     @staticmethod
     def _devices():
@@ -149,6 +182,34 @@ class StatusWriter:
             )
         return out
 
+    @staticmethod
+    def _doctor_html(status) -> str:
+        """One-line doctor verdict + anomaly banner for the page."""
+        lines = []
+        pipe = status.get("pipeline") or {}
+        if pipe.get("verdict") and pipe["verdict"] not in (
+            "no-data", "error"
+        ):
+            fracs = pipe.get("fractions") or {}
+            detail = ", ".join(
+                f"{k} {v:.2f}" for k, v in fracs.items()
+            )
+            lines.append(
+                f"<p>pipeline: <b>{html.escape(pipe['verdict'])}</b> "
+                f"({html.escape(detail)})</p>"
+            )
+        anomalies = status.get("anomalies") or {}
+        if anomalies.get("active"):
+            counts = ", ".join(
+                f"{k}={v}"
+                for k, v in (anomalies.get("counts") or {}).items()
+            )
+            lines.append(
+                '<p style="color:#b00"><b>anomaly active</b> '
+                f"({html.escape(counts)})</p>"
+            )
+        return "\n".join(lines)
+
     def _write_html(self, status) -> None:
         rows = []
         for split, m in status["summary"].items():
@@ -171,6 +232,7 @@ td,th{{border:1px solid #999;padding:4px 10px}}</style></head><body>
 best {status['best_value']} @ {status['best_epoch']} —
 {status['elapsed_seconds']}s elapsed</p>
 <p>devices: {html.escape(', '.join(status['devices']))}</p>
+{self._doctor_html(status)}
 <table><tr><th>split</th><th>n</th><th>loss</th><th>err%</th></tr>
 {''.join(rows)}</table>
 {''.join(

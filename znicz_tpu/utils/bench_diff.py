@@ -14,10 +14,10 @@ Accepted inputs (both sides independently):
 
 Direction is inferred per metric name — throughput-shaped names
 (``*_per_sec``, ``*_rps``, ``*_hit_rate``, ``*_vs_baseline``,
-``*_acceptance_rate``, ``mfu``...) regress when they DROP;
-latency/cost-shaped names (``*ttft*``, ``*latency*``, ``*_ms``,
-``*compile*``, ``preemptions``, ``retries``, ``failed``...) regress
-when they RISE.  Override per metric with ``--lower NAME`` /
+``*_acceptance_rate``, ``*_bytes_per_second``, ``mfu``...) regress
+when they DROP; latency/cost-shaped names (``*ttft*``, ``*latency*``,
+``*_ms``, ``*compile*``, ``preemptions``, ``retries``, ``failed``,
+``*_bound_frac``...) regress when they RISE.  Override per metric with ``--lower NAME`` /
 ``--higher NAME``; scope with ``--only PREFIX``; tune with
 ``--threshold FRAC`` (default 0.10 — a 10% move).
 
@@ -39,11 +39,11 @@ from typing import Dict, List, Optional, Tuple
 _LOWER_MARKERS = (
     "ttft", "latency", "_ms", "step_ms", "wait", "compile",
     "preemption", "retries", "eviction", "failed", "error", "shed",
-    "deadline", "cancelled", "queue_age", "lag",
+    "deadline", "cancelled", "queue_age", "lag", "_bound_frac",
 )
 _HIGHER_MARKERS = (
     "per_sec", "per_s", "rps", "hit_rate", "mfu", "concurrency",
-    "vs_dense", "vs_baseline", "acceptance_rate",
+    "vs_dense", "vs_baseline", "acceptance_rate", "bytes_per_second",
 )
 
 # fields of a record that are bookkeeping, not comparable metrics

@@ -36,6 +36,14 @@ point                     effect when armed
                           (heartbeat timeout: the ejection /
                           re-admission ladder without killing a real
                           server)
+``loader.fetch``          fires inside the prefetch producer's timed
+                          fetch of one batch (arm with ``delay=`` for
+                          a deterministic SLOW PRODUCER: the
+                          input-bound attribution fixture)
+``loader.h2d``            fires inside the H2D probe's measured
+                          region (arm with ``delay=`` for a slow
+                          host->device link: the h2d-bound
+                          attribution fixture)
 ========================  ==================================================
 
 Arming::

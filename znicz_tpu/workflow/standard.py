@@ -53,6 +53,7 @@ class StandardWorkflow(Workflow):
         parallel=None,
         epoch_dispatch: str = "auto",
         epoch_sync: str = "sync",
+        anomaly=True,
         rand_name: str = "default",
         name: str = "StandardWorkflow",
     ):
@@ -97,6 +98,7 @@ class StandardWorkflow(Workflow):
             parallel=parallel,
             epoch_dispatch=epoch_dispatch,
             epoch_sync=epoch_sync,
+            anomaly=anomaly,
             name=name,
         )
 

@@ -33,7 +33,7 @@ from znicz_tpu.ops.filling import fill
 from znicz_tpu.parallel.mesh import MODEL_AXIS
 from znicz_tpu.ops.normalization import layer_norm
 from znicz_tpu.workflow.snapshotter import Snapshotter
-from znicz_tpu.workflow.workflow import Workflow
+from znicz_tpu.workflow.workflow import Workflow, _global_norm
 
 
 def init_lm_params(
@@ -827,6 +827,8 @@ class TransformerLMWorkflow(Workflow):
             grads, metrics = jax.grad(loss_metrics, has_aux=True)(
                 state.params, x, mask
             )
+            # anomaly-watch input; popped before the epoch accumulator
+            metrics = dict(metrics, grad_norm=_global_norm(grads))
             hyper = self.hyper._replace(
                 learning_rate=self.hyper.learning_rate * lr_scale,
                 learning_rate_bias=(

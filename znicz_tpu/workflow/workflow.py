@@ -13,6 +13,8 @@ device->host syncs happen once per epoch, not per minibatch.
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -26,6 +28,8 @@ from znicz_tpu.nn import evaluator, optimizer
 from znicz_tpu.nn.decision import Decision
 from znicz_tpu.nn.train_state import TrainState
 from znicz_tpu.observability import PhaseTimer
+from znicz_tpu.observability import pipeline as pipeline_obs
+from znicz_tpu.observability.anomaly import StepAnomalyDetector
 from znicz_tpu.utils.profiling import Stopwatch
 from znicz_tpu.workflow.model import Model
 from znicz_tpu.workflow.snapshotter import Snapshotter
@@ -50,6 +54,17 @@ def _encode_metrics(m: Dict[str, Any], names) -> jnp.ndarray:
         else:  # sample-weighted sum; decoded back to a mean at epoch end
             vals.append(v * n)
     return jnp.stack(vals)
+
+
+def _global_norm(tree) -> jnp.ndarray:
+    """Global L2 norm of a pytree (f32 accumulation) — the grad-norm
+    half of the per-step anomaly watch vector, computed INSIDE the
+    existing jitted step (zero new compiled programs)."""
+    s = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        d = jnp.asarray(leaf, jnp.float32)
+        s = s + jnp.vdot(d, d)
+    return jnp.sqrt(s)
 
 
 def _decode_metrics(acc: np.ndarray, names) -> Dict[str, float]:
@@ -87,6 +102,7 @@ class Workflow(Logger):
         prefetch_batches: int = 2,
         epoch_dispatch: str = "auto",  # "auto" | "scan" | "step"
         epoch_sync: str = "sync",  # "sync" | "deferred"
+        anomaly=True,  # True = default detector; False/None = off
         name: str = "workflow",
     ):
         self.loader = loader
@@ -137,6 +153,24 @@ class Workflow(Logger):
             help="training host phase seconds (dispatch, stack, sync)",
             span_prefix="train/",
         )
+        # step anomaly flight recorder (docs/OBSERVABILITY.md "Training
+        # observability"): fed the per-step loss/grad-norm watch vector
+        # the jitted step piggybacks, LAGGED so detection never forces
+        # a device sync in the hot loop
+        if anomaly is True:
+            self.anomaly: Optional[StepAnomalyDetector] = (
+                StepAnomalyDetector()
+            )
+        else:
+            self.anomaly = anomaly or None
+        # host->device transfer probe for the streaming batch path; the
+        # step-wall histogram it pairs with is observed in the stepwise
+        # consumer loop
+        self._h2d_probe = pipeline_obs.H2DProbe()
+        self._step_wall = pipeline_obs.step_wall_seconds()
+        # scanned epochs' watch vectors, drained at the epoch's metric
+        # sync ([n_steps, 2] device arrays, copies started at dispatch)
+        self._pending_watch: list = []
 
     # ------------------------------------------------------------------
     def _metrics(self, out, y, mask):
@@ -157,6 +191,8 @@ class Workflow(Logger):
             grads, metrics = jax.grad(loss_fn, has_aux=True)(
                 state.params, state.key, state.step, x, y, mask
             )
+            # anomaly-watch input; popped before the epoch accumulator
+            metrics = dict(metrics, grad_norm=_global_norm(grads))
             hyper = [
                 h._replace(
                     learning_rate=h.learning_rate * lr_scale,
@@ -249,9 +285,38 @@ class Workflow(Logger):
             x, y = prep(x, y, ctx)
             return train_step(state, x, y, mask, lr_scale)
 
+        # trace-time gate: with the detector off the watch output is
+        # None, so the norm (and the grad_norm the steps put in their
+        # metrics) is dead code XLA eliminates — anomaly=False costs
+        # nothing on-device, not just a skipped host read
+        watch_enabled = self.anomaly is not None
+
         def train_acc(state, x, y, mask, lr_scale, acc, ctx):
+            """One train step + epoch-accumulator fold + the per-step
+            anomaly WATCH vector ``[loss, grad_norm]`` — extra outputs
+            of the SAME compiled program, so the flight recorder costs
+            zero new XLA programs (tests pin this)."""
             state2, m = train_step_full(state, x, y, mask, lr_scale, ctx)
-            return state2, combine(acc, m)
+            m = dict(m)
+            gn = m.pop("grad_norm", None)
+            if not watch_enabled:
+                return state2, combine(acc, m), None
+            if gn is None:
+                # steps that don't expose grads (SOM, RBM): the update
+                # norm ||params' - params|| catches the same
+                # pathologies (non-finite, explosion)
+                gn = _global_norm(
+                    jax.tree_util.tree_map(
+                        lambda a, b: b - a, state.params, state2.params
+                    )
+                )
+            watch = jnp.stack(
+                [
+                    jnp.asarray(m["loss"], jnp.float32),
+                    jnp.asarray(gn, jnp.float32),
+                ]
+            )
+            return state2, combine(acc, m), watch
 
         def eval_acc(params, x, y, mask, acc, ctx):
             x, y = prep(x, y, ctx)
@@ -274,13 +339,13 @@ class Workflow(Logger):
             def body(carry, b):
                 st, a = carry
                 x, y, mask, lr = b
-                st, a = train_acc(st, x, y, mask, lr, a, ctx)
-                return (st, a), None
+                st, a, w = train_acc(st, x, y, mask, lr, a, ctx)
+                return (st, a), w  # stacked [n_steps, 2] watch
 
-            (state, acc), _ = jax.lax.scan(
+            (state, acc), watches = jax.lax.scan(
                 body, (state, acc), (xs, ys, masks, lrs)
             )
-            return state, acc
+            return state, acc, watches
 
         def eval_epoch_scan(params, xs, ys, masks, acc, ctx):
             def body(a, b):
@@ -505,11 +570,18 @@ class Workflow(Logger):
                         np.float32,
                     )
                     lrs = self._put_replicated(lrs_host)
-                    self.state, acc = self._train_epoch_scan(
+                    start_step = self._host_step
+                    self.state, acc, watches = self._train_epoch_scan(
                         self.state, xs, ys, masks, lrs,
                         self._acc_init(), self._ctx,
                     )
                     self._host_step += len(mbs)
+                    if self.anomaly is not None:
+                        # tiny [n_steps, 2] array; the copy rides behind
+                        # the dispatch and is read at the epoch's sync
+                        if hasattr(watches, "copy_to_host_async"):
+                            watches.copy_to_host_async()
+                        self._pending_watch.append((start_step, watches))
                 else:
                     acc = self._eval_epoch_scan(
                         self.state.params, xs, ys, masks,
@@ -621,26 +693,50 @@ class Workflow(Logger):
             self.parallel.shard_batch if self.parallel is not None else jnp.asarray
         )
 
-        def staged(it):
-            """Host gather AND device_put per batch; running this inside the
-            prefetch worker overlaps the host->device transfer with the
-            previous step's compute (device_put is thread-safe and async)."""
-            for split, mb in it:
+        def stage_item(item):
+            """Host gather AND device_put for one batch; run inside the
+            prefetch worker this overlaps the host->device transfer with
+            the previous step's compute (device_put is thread-safe and
+            async).  The H2D probe owns the stage timing + bytes (the
+            prefetch stage split is told NOT to double-time it)."""
+            split, mb = item
+            # autoencoder target IS the input: reuse the device array
+            # instead of transferring the batch twice
+            y_host = (
+                None
+                if self.target == "input"
+                else self._batch_target(mb)
+            )
+            nbytes = (
+                getattr(mb.data, "nbytes", 0)
+                + getattr(y_host, "nbytes", 0)
+                + getattr(mb.mask, "nbytes", 0)
+            )
+            with self._h2d_probe.measure(nbytes):
                 x = put(mb.data)
-                # autoencoder target IS the input: reuse the device array
-                # instead of transferring the batch twice
-                y = (
-                    x
-                    if self.target == "input"
-                    else put(self._batch_target(mb))
-                )
-                yield split, x, y, put(mb.mask)
+                y = x if y_host is None else put(y_host)
+                mask = put(mb.mask)
+            return split, x, y, mask
 
-        epoch_iter = staged(self.loader.epoch())
+        epoch_iter = self.loader.epoch()
         if self.prefetch_batches:
             from znicz_tpu.loader.prefetch import prefetch
 
-            epoch_iter = prefetch(epoch_iter, self.prefetch_batches)
+            # transform_stage=None: the probe above already observes
+            # the h2d stage — the producer's fetch/enqueue split still
+            # comes from prefetch itself
+            epoch_iter = prefetch(
+                epoch_iter,
+                self.prefetch_batches,
+                transform=stage_item,
+                transform_stage=None,
+            )
+        else:
+            epoch_iter = map(stage_item, epoch_iter)
+        # lagged per-step anomaly watch: host copies start at dispatch,
+        # values are read a few steps later — detection without a sync
+        watch_q: deque = deque()
+        t_prev = time.perf_counter()
         for split, x, y, mask in epoch_iter:
             with self.timer.phase(f"dispatch/{split}"):
                 acc = accs.get(split)
@@ -652,20 +748,83 @@ class Workflow(Logger):
                         if self.lr_policy
                         else 1.0
                     )
-                    self.state, acc = self._train_step(
+                    self.state, acc, watch = self._train_step(
                         self.state, x, y, mask, lr_scale, acc, self._ctx
                     )
                     self._host_step += 1
                 else:
+                    watch = None
                     acc = self._eval_step(
                         self.state.params, x, y, mask, acc, self._ctx
                     )
                 accs[split] = acc
+            # consumer-side step wall (prefetch wait + dispatch + host
+            # bookkeeping): the denominator of the pipeline attribution
+            now = time.perf_counter()
+            step_wall = now - t_prev
+            t_prev = now
+            self._step_wall.observe(step_wall)
+            if watch is not None and self.anomaly is not None:
+                if hasattr(watch, "copy_to_host_async"):
+                    watch.copy_to_host_async()
+                watch_q.append(
+                    (self._host_step - 1, watch, step_wall)
+                )
+                if len(watch_q) > 2:  # ~2 steps of transfer lag
+                    self._feed_watch(*watch_q.popleft())
+        while watch_q:
+            self._feed_watch(*watch_q.popleft())
         return accs
+
+    def _feed_watch(self, step, watch, step_seconds=None) -> None:
+        """Hand one lagged watch vector to the anomaly detector.  The
+        read is of an already-transferred tiny array (the async copy
+        started at dispatch); the detector must never kill training."""
+        if self.anomaly is None:
+            return
+        try:
+            vals = np.asarray(
+                jax.device_get(watch),  # znicz-check: disable=ZNC007
+                np.float32,
+            )
+            self.anomaly.observe_step(
+                int(step),
+                loss=float(vals[0]),
+                grad_norm=float(vals[1]),
+                step_seconds=step_seconds,
+            )
+        except Exception:
+            self.logger.exception("anomaly watch feed failed")
+
+    def _drain_watches(self) -> None:
+        """Feed the scanned epochs' pending watch stacks ([n_steps, 2])
+        to the detector — called at the epoch's metric sync, where a
+        device fetch already happens."""
+        pending, self._pending_watch = self._pending_watch, []
+        if self.anomaly is None:
+            return
+        for start_step, watches in pending:
+            try:
+                rows = np.asarray(
+                    jax.device_get(watches),  # znicz-check: disable=ZNC007
+                    np.float32,
+                )
+            except Exception:
+                self.logger.exception("anomaly watch drain failed")
+                continue
+            for i, row in enumerate(rows):
+                self.anomaly.observe_step(
+                    start_step + i,
+                    loss=float(row[0]),
+                    grad_norm=float(row[1]),
+                )
 
     def _finish_epoch(
         self, accs: Dict[str, jax.Array], retained=None
     ) -> Dict[str, Any]:
+        # scanned-epoch watch vectors resolve here, where a device
+        # fetch happens anyway (their async copies started at dispatch)
+        self._drain_watches()
         with self.timer.phase("metrics_sync"):
             # one tiny existing-buffer fetch per split (no per-batch
             # syncs) — the per-EPOCH fetch this design exists to bound
