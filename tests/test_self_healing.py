@@ -1,0 +1,1131 @@
+"""Self-healing training: chaos suite (docs/TRAINING.md).
+
+The SURVEY §4 functional-test pattern, upgraded: every failure mode the
+self-healing layer claims to survive is INJECTED here (utils/faults.py)
+and the run must complete with the documented typed events/counters —
+and, where the contract is exactness, the recovered trajectory must
+golden-match the unfaulted run: crash at an epoch boundary, SIGTERM
+mid-epoch, snapshot-write failure, corrupt/truncated snapshots, an
+injected NaN step (anomaly-triggered rollback) and a flaky loader
+fetch.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu import observability
+from znicz_tpu.core import prng
+from znicz_tpu.loader import LoaderFetchError, PrefetchProducerError, datasets
+from znicz_tpu.observability import pipeline as pipeline_mod
+from znicz_tpu.observability.pipeline import PipelineAttribution
+from znicz_tpu.observability.registry import MetricsRegistry, get_registry
+from znicz_tpu.utils import faults
+from znicz_tpu.workflow import (
+    RecoveryPolicy,
+    RollbackExhaustedError,
+    SnapshotCorruptError,
+    SnapshotWriteError,
+    StandardWorkflow,
+    Snapshotter,
+    TrainingPreempted,
+    find_latest_valid,
+    load_snapshot,
+)
+from znicz_tpu.workflow.snapshotter import verify_snapshot
+
+MLP = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 16}},
+    {"type": "softmax", "->": {"output_sample_shape": 10}},
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_gauges():
+    faults.clear()
+    yield
+    faults.clear()
+    # the give-up gauge is process-global; a budget test must not leak
+    # a "looping" verdict into later registry reads
+    observability.gauge(pipeline_mod.ROLLBACK_GIVE_UP_METRIC).set(0.0)
+
+
+def _mnist_workflow(tmp_path=None, *, seed=77, max_epochs=4,
+                    loader_kwargs=None, **kw):
+    prng.seed_all(seed)
+    loader = datasets.mnist(
+        n_train=192, n_test=32, minibatch_size=64,
+        **(loader_kwargs or {}),
+    )
+    kw.setdefault("decision_config", {"max_epochs": max_epochs})
+    kw.setdefault(
+        "default_hyper", {"learning_rate": 0.1, "gradient_moment": 0.9}
+    )
+    wf = StandardWorkflow(
+        loader, MLP,
+        snapshot_dir=str(tmp_path) if tmp_path else None,
+        **kw,
+    )
+    return wf
+
+
+def _history_key(dec):
+    return [
+        (h["train"]["n_err"], round(h["train"]["loss"], 8))
+        for h in dec.history
+    ]
+
+
+# ---------------------------------------------------------------------------
+class TestSnapshotIntegrity:
+    def _write_one(self, tmp_path, tag="epoch0", compress=False):
+        import jax
+        import jax.numpy as jnp
+
+        from znicz_tpu.nn.train_state import TrainState
+
+        snap = Snapshotter(str(tmp_path), "t", compress=compress)
+        st = TrainState.create(
+            [{"w": jnp.arange(8.0)}], jax.random.key(3)
+        )
+        return snap, snap.save(st, {"decision": {"epoch": 1}}, tag=tag)
+
+    def test_sidecar_written_and_verifies(self, tmp_path):
+        _, path = self._write_one(tmp_path)
+        assert os.path.exists(path + ".sha256")
+        verify_snapshot(path)  # no raise
+        state, host = load_snapshot(path)
+        assert host["decision"]["epoch"] == 1
+
+    def test_truncated_file_is_typed_corrupt(self, tmp_path):
+        _, path = self._write_one(tmp_path)
+        with open(path, "rb") as f:
+            raw = f.read()
+        with open(path, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        with pytest.raises(SnapshotCorruptError):
+            load_snapshot(path)
+        with pytest.raises(SnapshotCorruptError):
+            verify_snapshot(path)
+
+    def test_bitflip_fails_digest(self, tmp_path):
+        _, path = self._write_one(tmp_path)
+        with open(path, "r+b") as f:
+            f.seek(30)
+            b = f.read(1)
+            f.seek(30)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(SnapshotCorruptError, match="sha256"):
+            load_snapshot(path)
+
+    def test_truncated_gz_without_sidecar_is_typed(self, tmp_path):
+        # pre-sidecar snapshots (or a lost sidecar) still fail TYPED:
+        # decode errors map to SnapshotCorruptError, not EOFError
+        _, path = self._write_one(tmp_path, compress=True)
+        os.remove(path + ".sha256")
+        with open(path, "rb") as f:
+            raw = f.read()
+        with open(path, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        with pytest.raises(SnapshotCorruptError):
+            load_snapshot(path)
+
+    def test_missing_sidecar_still_loads(self, tmp_path):
+        _, path = self._write_one(tmp_path)
+        os.remove(path + ".sha256")
+        load_snapshot(path)  # back-compat: verified by decode
+        verify_snapshot(path)
+
+    def test_find_latest_valid_skips_corrupt_newest(self, tmp_path):
+        _, old = self._write_one(tmp_path, tag="epoch0")
+        _, new = self._write_one(tmp_path, tag="epoch1")
+        # force a clear mtime ordering, then corrupt the newest
+        now = time.time()
+        os.utime(old, (now - 60, now - 60))
+        os.utime(new, (now, now))
+        with open(new, "wb") as f:
+            f.write(b"garbage")
+        assert find_latest_valid(str(tmp_path)) == old
+        assert find_latest_valid(str(tmp_path), prefix="t") == old
+        assert find_latest_valid(str(tmp_path), prefix="other") is None
+
+    def test_find_latest_valid_empty_dir(self, tmp_path):
+        assert find_latest_valid(str(tmp_path)) is None
+        assert find_latest_valid(str(tmp_path / "absent")) is None
+
+    def test_version_skewed_snapshot_is_skipped_not_resumed(
+        self, tmp_path
+    ):
+        # a sidecar-valid snapshot recording a FOREIGN format version
+        # must not be selected for resume (load would ValueError and
+        # crash-loop the supervisor); find_latest_valid falls through
+        import znicz_tpu.workflow.snapshotter as snap_mod
+
+        _, old = self._write_one(tmp_path, tag="epoch0")
+        _, new = self._write_one(tmp_path, tag="epoch1")
+        now = time.time()
+        os.utime(old, (now - 60, now - 60))
+        # rewrite the newest sidecar claiming a future format version
+        with open(new, "rb") as f:
+            digest = __import__("hashlib").sha256(f.read()).hexdigest()
+        with open(new + ".sha256", "w") as f:
+            f.write(
+                f"{digest}  {os.path.basename(new)}  "
+                f"v{snap_mod.FORMAT_VERSION + 1}\n"
+            )
+        with pytest.raises(ValueError, match="format"):
+            verify_snapshot(new)
+        assert find_latest_valid(str(tmp_path)) == old
+
+    def test_injected_load_fault_is_typed(self, tmp_path):
+        _, path = self._write_one(tmp_path)
+        with faults.injected("snapshot.load", times=1):
+            with pytest.raises(SnapshotCorruptError):
+                load_snapshot(path)
+        load_snapshot(path)  # disarmed: loads fine
+
+
+class TestSnapshotWriteFailure:
+    def test_direct_save_raises_typed_and_cleans_tmp(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from znicz_tpu.nn.train_state import TrainState
+
+        snap = Snapshotter(str(tmp_path), "t", compress=False)
+        st = TrainState.create([{"w": jnp.ones(2)}], jax.random.key(0))
+        with faults.injected("snapshot.write", times=1):
+            with pytest.raises(SnapshotWriteError):
+                snap.save(st, {}, tag="x")
+        leftovers = [
+            p for p in os.listdir(tmp_path) if p.endswith(".tmp")
+        ]
+        assert leftovers == []
+        assert not os.path.exists(snap._path("x"))
+
+    def test_sidecar_failure_after_replace_drops_stale_sidecar(
+        self, tmp_path, monkeypatch
+    ):
+        # disk dies between the data replace and the sidecar replace
+        # while OVERWRITING a tag: the new data file already landed, so
+        # the save is a SUCCESS (warning logged), the stale old sidecar
+        # must not condemn the good new file, and the path stays in the
+        # retention/resume bookkeeping
+        import jax
+        import jax.numpy as jnp
+
+        import znicz_tpu.workflow.snapshotter as snap_mod
+        from znicz_tpu.nn.train_state import TrainState
+
+        snap = Snapshotter(str(tmp_path), "t", compress=False)
+        st = TrainState.create([{"w": jnp.ones(2)}], jax.random.key(0))
+        path = snap.save(st, {"n": 1}, tag="best")
+        real_replace = os.replace
+
+        def flaky_replace(src, dst):
+            if dst.endswith(".sha256"):
+                raise OSError("disk full writing sidecar")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(snap_mod.os, "replace", flaky_replace)
+        assert snap.save(st, {"n": 2}, tag="best") == path
+        monkeypatch.setattr(snap_mod.os, "replace", real_replace)
+        # no stale sidecar left; the new data file verifies by decode
+        assert not os.path.exists(path + ".sha256")
+        verify_snapshot(path)
+        _, host = load_snapshot(path)
+        assert host["n"] == 2  # the NEW content, loadable
+        assert find_latest_valid(str(tmp_path)) == path
+
+    def test_run_survives_snapshot_write_failure(self, tmp_path):
+        # chaos acceptance: one failed checkpoint write costs a
+        # checkpoint, never the run — counted, logged, next interval
+        # snapshots fine
+        before = _snapshot_failures_total()
+        wf = _mnist_workflow(
+            tmp_path, snapshot_config={"interval": 1, "compress": False}
+        )
+        wf.initialize(seed=77)
+        # the FIRST write (best or epoch0) fails; everything later lands
+        faults.inject("snapshot.write", times=1)
+        dec = wf.run()
+        assert dec.epoch == 4  # run completed
+        assert _snapshot_failures_total() == before + 1
+        assert (tmp_path / "StandardWorkflow_epoch3.pickle").exists()
+        assert find_latest_valid(str(tmp_path)) is not None
+
+
+def _snapshot_failures_total() -> float:
+    fam = get_registry().metrics().get(
+        pipeline_mod.SNAPSHOT_FAILURES_METRIC
+    )
+    if fam is None:
+        return 0.0
+    return sum(c.value for c in fam.children().values())
+
+
+class TestPruneByVerifiedSet:
+    def test_never_deletes_only_valid_snapshot(self, tmp_path):
+        # regression (ISSUE 14 satellite): keep=1 with a corrupt NEWEST
+        # file must retain the older valid snapshot past the bound
+        import jax
+        import jax.numpy as jnp
+
+        from znicz_tpu.nn.train_state import TrainState
+
+        st = TrainState.create([{"w": jnp.ones(2)}], jax.random.key(0))
+        snap = Snapshotter(
+            str(tmp_path), "t", interval=1, keep=1, compress=False
+        )
+        p0 = snap.save(st, {}, tag="epoch0")
+        p1 = snap.save(st, {}, tag="epoch1")
+        with open(p1, "wb") as f:
+            f.write(b"garbage")  # newest corrupt (sidecar now mismatches)
+        # a fresh process recovers both into the retention ledger
+        snap2 = Snapshotter(
+            str(tmp_path), "t", interval=1, keep=1, compress=False
+        )
+        snap2.prune()
+        assert os.path.exists(p0), "the only valid snapshot was deleted"
+        assert find_latest_valid(str(tmp_path)) == p0
+
+    def test_prunes_normally_when_newer_are_valid(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from znicz_tpu.nn.train_state import TrainState
+
+        st = TrainState.create([{"w": jnp.ones(2)}], jax.random.key(0))
+        snap = Snapshotter(
+            str(tmp_path), "t", interval=1, keep=1, compress=False
+        )
+        for e in range(3):
+            snap.maybe_save(st, {}, epoch=e, improved=False)
+        files = sorted(
+            p for p in os.listdir(tmp_path) if p.endswith(".pickle")
+        )
+        assert files == ["t_epoch2.pickle"]
+
+
+# ---------------------------------------------------------------------------
+class TestAnomalyTriggeredRollback:
+    def test_nan_rollback_golden_matches_unfaulted(self, tmp_path):
+        # the acceptance golden: injected NaN -> rollback to the last
+        # good snapshot -> with perturbation off the replay is
+        # byte-identical to a run that never faulted
+        wf_a = _mnist_workflow(tmp_path / "a",
+                               snapshot_config={"interval": 1})
+        wf_a.initialize(seed=77)
+        dec_a = wf_a.run()
+
+        pol = RecoveryPolicy(
+            max_rollbacks=2, lr_backoff=1.0, perturb=False
+        )
+        wf_b = _mnist_workflow(
+            tmp_path / "b", snapshot_config={"interval": 1},
+            recovery=pol,
+        )
+        wf_b.initialize(seed=77)
+        faults.inject("train.step_nan", flag=True, times=1, after=7)
+        dec_b = wf_b.run()
+        assert pol.rollbacks_used == 1
+        assert pol.events[0]["kind"] == "rollback"
+        assert pol.events[0]["reason"] == "non_finite_loss"
+        assert _history_key(dec_a) == _history_key(dec_b)
+        np.testing.assert_array_equal(
+            np.asarray(wf_a.state.params[0]["weights"]),
+            np.asarray(wf_b.state.params[0]["weights"]),
+        )
+
+    def test_rollback_counter_and_status_surface(self, tmp_path):
+        from znicz_tpu.services.web_status import StatusWriter
+
+        before = _counter_total(pipeline_mod.ROLLBACKS_METRIC)
+        pol = RecoveryPolicy(max_rollbacks=3, lr_backoff=0.5)
+        wf = _mnist_workflow(
+            tmp_path, snapshot_config={"interval": 1}, recovery=pol
+        )
+        wf.services.append(StatusWriter(str(tmp_path / "status")))
+        wf.initialize(seed=77)
+        faults.inject("train.step_nan", flag=True, times=1, after=7)
+        wf.run()
+        assert pol.rollbacks_used == 1
+        assert pol.lr_scale == 0.5  # backoff applied
+        assert (
+            _counter_total(pipeline_mod.ROLLBACKS_METRIC) >= before + 1
+        )
+        status = json.loads(
+            (tmp_path / "status" / "status.json").read_text()
+        )
+        assert status["recovery"]["rollbacks_used"] == 1
+        assert status["recovery"]["events"][0]["kind"] == "rollback"
+        # metrics.prom carries the counter the doctor reads
+        prom = (tmp_path / "status" / "metrics.prom").read_text()
+        assert pipeline_mod.ROLLBACKS_METRIC in prom
+
+    def test_budget_exhaustion_is_typed_give_up(self, tmp_path):
+        pol = RecoveryPolicy(max_rollbacks=1, perturb=False,
+                             lr_backoff=1.0)
+        wf = _mnist_workflow(
+            tmp_path, snapshot_config={"interval": 1}, recovery=pol
+        )
+        wf.initialize(seed=77)
+        # every step's loss reads NaN: rollback once, re-fault, give up
+        faults.inject("train.step_nan", flag=True)
+        with pytest.raises(RollbackExhaustedError):
+            wf.run()
+        faults.clear()
+        assert pol.gave_up
+        assert pol.rollbacks_used == 1
+        assert pol.events[-1]["kind"] == "give_up"
+        gauge = get_registry().metrics()[
+            pipeline_mod.ROLLBACK_GIVE_UP_METRIC
+        ]
+        assert any(
+            c.value == 1.0 for c in gauge.children().values()
+        )
+
+    def test_epoch_start_buffer_fallback_without_snapshotter(self):
+        # no snapshot dir at all: rollback restores the in-memory
+        # epoch-START buffer and the run still completes
+        pol = RecoveryPolicy(max_rollbacks=2, perturb=False,
+                             lr_backoff=1.0)
+        wf_a = _mnist_workflow()
+        wf_a.initialize(seed=77)
+        dec_a = wf_a.run()
+        wf = _mnist_workflow(recovery=pol)
+        wf.initialize(seed=77)
+        faults.inject("train.step_nan", flag=True, times=1, after=7)
+        dec_b = wf.run()
+        assert pol.rollbacks_used == 1
+        assert pol.events[0]["source"] == "epoch-start buffer"
+        assert _history_key(dec_a) == _history_key(dec_b)
+
+    def test_perturbed_rollback_still_converges(self, tmp_path):
+        pol = RecoveryPolicy(
+            max_rollbacks=2, lr_backoff=0.5, perturb=True
+        )
+        wf = _mnist_workflow(
+            tmp_path, snapshot_config={"interval": 1}, recovery=pol,
+            max_epochs=5,
+        )
+        wf.initialize(seed=77)
+        faults.inject("train.step_nan", flag=True, times=1, after=7)
+        dec = wf.run()
+        assert pol.rollbacks_used == 1
+        assert pol.lr_scale == 0.5
+        # perturbed replay differs from the golden path but still learns
+        assert dec.history[-1]["train"]["err_pct"] < 10.0
+
+    def test_scan_path_rollback(self, tmp_path):
+        # scanned dispatch: verdicts surface at the epoch's metric
+        # sync; the rollback discards the poisoned epoch and replays
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+
+        def build(recovery=None, out=None):
+            prng.seed_all(31)
+            gen = np.random.default_rng(5)
+            imgs = gen.integers(0, 256, (128, 8, 8, 1), dtype=np.uint8)
+            labels = (imgs.mean(axis=(1, 2, 3)) > 127).astype(np.int32)
+            ld = FullBatchLoader(
+                {"train": imgs}, {"train": labels}, minibatch_size=32,
+                normalization="range",
+                normalization_kwargs={"scale": 255.0, "shift": -0.5},
+                device_resident=True,
+            )
+            wf = StandardWorkflow(
+                ld,
+                [{"type": "all2all_tanh",
+                  "->": {"output_sample_shape": 8}},
+                 {"type": "softmax", "->": {"output_sample_shape": 2}}],
+                decision_config={"max_epochs": 3},
+                default_hyper={"learning_rate": 0.1},
+                epoch_dispatch="scan",
+                snapshot_dir=out,
+                snapshot_config={"interval": 1} if out else None,
+                recovery=recovery,
+            )
+            wf.initialize(seed=31)
+            assert wf._use_epoch_scan()
+            return wf
+
+        dec_a = build().run()
+        pol = RecoveryPolicy(max_rollbacks=2, perturb=False,
+                             lr_backoff=1.0)
+        wf_b = build(recovery=pol, out=str(tmp_path))
+        # poison one scan row of epoch 1 (after epoch 0's 4 rows)
+        faults.inject("train.step_nan", flag=True, times=1, after=5)
+        dec_b = wf_b.run()
+        assert pol.rollbacks_used == 1
+        assert _history_key(dec_a) == _history_key(dec_b)
+
+    def test_recovery_requires_detector(self):
+        with pytest.raises(ValueError, match="anomaly"):
+            _mnist_workflow(
+                anomaly=False, recovery=RecoveryPolicy()
+            )
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RecoveryPolicy(max_rollbacks=0)
+        with pytest.raises(ValueError):
+            RecoveryPolicy(lr_backoff=0.0)
+        with pytest.raises(ValueError):
+            RecoveryPolicy(rollback_on_spike=-1)
+
+    def test_zero_new_programs_across_rollback(self, tmp_path):
+        # acceptance pin: restore re-feeds the ALREADY-COMPILED step —
+        # nothing lands in the device ledger / compile counters, and
+        # the train step stays ONE jit cache entry through a rollback
+        from znicz_tpu.observability import device
+
+        ledger_before = device.program_count()
+        compile_hist = get_registry().metrics().get(
+            "znicz_compile_seconds"
+        )
+        obs_before = (
+            sum(c.count for c in compile_hist.children().values())
+            if compile_hist is not None
+            else 0
+        )
+        pol = RecoveryPolicy(max_rollbacks=2, perturb=False,
+                             lr_backoff=1.0)
+        wf = _mnist_workflow(
+            tmp_path, snapshot_config={"interval": 1}, recovery=pol
+        )
+        wf.initialize(seed=77)
+        faults.inject("train.step_nan", flag=True, times=1, after=7)
+        wf.run()
+        assert pol.rollbacks_used == 1
+        assert wf._train_step._cache_size() == 1
+        assert device.program_count() == ledger_before
+        compile_hist = get_registry().metrics().get(
+            "znicz_compile_seconds"
+        )
+        obs_after = (
+            sum(c.count for c in compile_hist.children().values())
+            if compile_hist is not None
+            else 0
+        )
+        assert obs_after == obs_before
+
+
+# ---------------------------------------------------------------------------
+class TestLoaderFaultTolerance:
+    def test_flaky_fetch_retries_transparently(self, tmp_path):
+        before = _counter_total(pipeline_mod.LOADER_RETRIES_METRIC)
+        wf_a = _mnist_workflow()
+        wf_a.initialize(seed=77)
+        dec_a = wf_a.run()
+        wf_b = _mnist_workflow(
+            loader_kwargs={"fetch_retries": 3, "fetch_backoff_s": 0.0}
+        )
+        wf_b.initialize(seed=77)
+        faults.inject("loader.fetch_flaky", times=2)
+        dec_b = wf_b.run()
+        # retries are invisible to the trajectory
+        assert _history_key(dec_a) == _history_key(dec_b)
+        assert (
+            _counter_total(pipeline_mod.LOADER_RETRIES_METRIC)
+            >= before + 2
+        )
+
+    def test_retry_budget_exhaustion_is_typed(self):
+        wf = _mnist_workflow(
+            loader_kwargs={"fetch_retries": 1, "fetch_backoff_s": 0.0}
+        )
+        wf.initialize(seed=77)
+        faults.inject("loader.fetch_flaky")  # every attempt fails
+        with pytest.raises(LoaderFetchError):
+            wf.run()
+        faults.clear()
+
+    def test_skip_bad_batch_counted(self):
+        before = _counter_total(pipeline_mod.LOADER_SKIPPED_METRIC)
+        wf = _mnist_workflow(
+            max_epochs=1,
+            loader_kwargs={
+                "fetch_retries": 0,
+                "skip_bad_batches": True,
+            },
+        )
+        wf.initialize(seed=77)
+        faults.inject("loader.fetch_flaky", times=1)
+        dec = wf.run()
+        assert (
+            _counter_total(pipeline_mod.LOADER_SKIPPED_METRIC)
+            == before + 1
+        )
+        # one 64-row train batch dropped from the 192-sample epoch
+        assert dec.history[0]["train"]["n_samples"] == 128.0
+
+    def test_dead_producer_is_typed_not_a_hang(self, monkeypatch):
+        import threading as threading_mod
+
+        from znicz_tpu.loader import prefetch as prefetch_mod
+
+        class _DeadThread:
+            def __init__(self, *a, **k):
+                pass
+
+            def start(self):
+                pass
+
+            def is_alive(self):
+                return False
+
+        monkeypatch.setattr(
+            prefetch_mod.threading, "Thread", _DeadThread
+        )
+        assert threading_mod.Thread is _DeadThread  # same module object
+        with pytest.raises(PrefetchProducerError):
+            list(prefetch_mod.prefetch(iter([1, 2, 3]), 2))
+
+    def test_producer_exception_reraises_typed_original(self):
+        from znicz_tpu.loader.prefetch import prefetch
+
+        def boom():
+            yield 1
+            raise LoaderFetchError("flaky source died")
+
+        out = []
+        with pytest.raises(LoaderFetchError, match="flaky source"):
+            for item in prefetch(boom(), 2):
+                out.append(item)
+        assert out == [1]
+
+
+def _counter_total(name: str) -> float:
+    fam = get_registry().metrics().get(name)
+    if fam is None:
+        return 0.0
+    return sum(c.value for c in fam.children().values())
+
+
+# ---------------------------------------------------------------------------
+class TestGracefulStop:
+    def test_stop_between_epochs_writes_emergency_snapshot(
+        self, tmp_path
+    ):
+        wf = _mnist_workflow(tmp_path)
+        wf.initialize(seed=77)
+        assert wf.run_epoch() is not None
+        wf.request_stop()
+        with pytest.raises(TrainingPreempted) as exc_info:
+            wf.run_epoch()
+        path = exc_info.value.snapshot_path
+        assert path and "emergency" in path
+        verify_snapshot(path)
+        assert find_latest_valid(str(tmp_path)) == path
+
+    def test_mid_epoch_stop_resumes_golden(self, tmp_path):
+        # SIGTERM-equivalent mid-epoch: the emergency snapshot is the
+        # epoch-START buffer, so the resumed run replays the aborted
+        # epoch exactly and the whole trajectory golden-matches
+        wf_a = _mnist_workflow(tmp_path / "a")
+        wf_a.initialize(seed=77)
+        dec_a = wf_a.run()
+
+        wf_b = _mnist_workflow(tmp_path / "b")
+        wf_b.enable_emergency_snapshots()
+        wf_b.initialize(seed=77)
+
+        def stop_at(base, step):
+            if step == 4:  # mid epoch 1 (3 steps per epoch)
+                wf_b.request_stop()
+            return base
+
+        wf_b.lr_policy = stop_at
+        with pytest.raises(TrainingPreempted):
+            wf_b.run()
+        snap = find_latest_valid(str(tmp_path / "b"))
+        assert snap and "emergency" in snap
+
+        prng.seed_all(77)
+        wf_c = _mnist_workflow(tmp_path / "c")
+        wf_c.initialize(snapshot=snap)
+        assert wf_c.decision.epoch == 1  # replays the aborted epoch
+        dec_c = wf_c.run()
+        assert _history_key(dec_a) == _history_key(dec_c)
+        np.testing.assert_array_equal(
+            np.asarray(wf_a.state.params[0]["weights"]),
+            np.asarray(wf_c.state.params[0]["weights"]),
+        )
+
+    def test_mid_epoch_stop_deferred_sync_resumes_golden(self, tmp_path):
+        # deferred sync + save_best: mid-epoch, self.state is the NEXT
+        # epoch's partial state — the flush must write the pending
+        # epoch from the RETAINED buffer and the emergency snapshot is
+        # the (retained state, flushed decision) start quadruple, so
+        # the resume still golden-matches
+        def build(out):
+            return _mnist_workflow(out, epoch_sync="deferred")
+
+        wf_a = build(tmp_path / "a")
+        wf_a.initialize(seed=77)
+        dec_a = wf_a.run()
+
+        wf_b = build(tmp_path / "b")
+        wf_b.initialize(seed=77)
+
+        def stop_at(base, step):
+            if step == 7:  # mid epoch 2 (3 steps/epoch)
+                wf_b.request_stop()
+            return base
+
+        wf_b.lr_policy = stop_at
+        with pytest.raises(TrainingPreempted):
+            wf_b.run()
+        snap = find_latest_valid(str(tmp_path / "b"))
+        assert snap and "emergency" in snap
+
+        prng.seed_all(77)
+        wf_c = _mnist_workflow(tmp_path / "c")  # resume in sync mode
+        wf_c.initialize(snapshot=snap)
+        assert wf_c.decision.epoch == 2  # replays the aborted epoch
+        dec_c = wf_c.run()
+        assert _history_key(dec_a) == _history_key(dec_c)
+
+    def test_stop_without_snapshotter_still_typed(self):
+        wf = _mnist_workflow()
+        wf.initialize(seed=77)
+        wf.request_stop()
+        with pytest.raises(TrainingPreempted) as exc_info:
+            wf.run_epoch()
+        assert exc_info.value.snapshot_path is None
+
+
+# ---------------------------------------------------------------------------
+class TestTransformerChaosResume:
+    def test_crash_at_epoch_k_resumes_golden(self, tmp_path):
+        # the exact-resume-under-chaos contract for the SECOND workflow
+        # family: crash (process death simulated by abandoning the
+        # object) after epoch 1 -> find_latest_valid -> golden
+        from znicz_tpu.loader.fullbatch import FullBatchLoader
+        from znicz_tpu.workflow import TransformerLMWorkflow
+
+        tokens = np.asarray(
+            np.random.default_rng(7).integers(0, 16, (16, 24)), np.int32
+        )
+
+        def build(max_epochs, snap_dir=None):
+            prng.seed_all(13)
+            ld = FullBatchLoader(
+                {"train": tokens.copy()}, minibatch_size=16
+            )
+            snapshotter = (
+                Snapshotter(
+                    snap_dir, "lm", interval=1, compress=False
+                )
+                if snap_dir
+                else None
+            )
+            return TransformerLMWorkflow(
+                ld, vocab=16, d_model=32, n_layers=1, n_heads=2,
+                max_epochs=max_epochs, snapshotter=snapshotter,
+            )
+
+        wf_a = build(4)
+        wf_a.initialize(seed=13)
+        dec_a = wf_a.run()
+
+        wf_b = build(4, str(tmp_path))
+        wf_b.initialize(seed=13)
+        faults.inject("train.crash", after=2, times=1)
+        with pytest.raises(faults.FaultInjected):
+            wf_b.run()
+        faults.clear()
+
+        snap = find_latest_valid(str(tmp_path), prefix="lm")
+        assert snap is not None
+        prng.seed_all(13)
+        wf_c = build(4)
+        wf_c.initialize(snapshot=snap)
+        assert wf_c.decision.epoch == 2
+        dec_c = wf_c.run()
+        a_losses = [
+            round(h["train"]["loss"], 8) for h in dec_a.history
+        ]
+        c_losses = [
+            round(h["train"]["loss"], 8) for h in dec_c.history
+        ]
+        assert a_losses == c_losses
+
+
+# ---------------------------------------------------------------------------
+_CHILD_MODULE = """
+import json
+import os
+import signal
+
+import numpy as np
+
+from znicz_tpu.loader import datasets
+from znicz_tpu.workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 16}},
+    {"type": "softmax", "->": {"output_sample_shape": 10}},
+]
+
+
+def run(load, main):
+    loader = datasets.mnist(n_train=192, n_test=32, minibatch_size=64)
+    wf = load(
+        StandardWorkflow, loader, LAYERS,
+        decision_config={"max_epochs": 4},
+        default_hyper={"learning_rate": 0.1, "gradient_moment": 0.9},
+    )
+    sigterm_step = int(os.environ.get("ZNICZ_TEST_SIGTERM_STEP", "0"))
+    if sigterm_step:
+        def pol(base, step):
+            if step == sigterm_step:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return base
+        wf.lr_policy = pol
+    main()
+    dec = wf.decision
+    digest = float(
+        np.abs(np.asarray(wf.state.params[0]["weights"])).sum()
+    )
+    print("RESULT " + json.dumps({
+        "epochs": dec.epoch,
+        "history": [
+            [h["train"]["n_err"], round(h["train"]["loss"], 8)]
+            for h in dec.history
+        ],
+        "digest": round(digest, 5),
+    }))
+"""
+
+
+def _run_child(module_path, extra_args, *, env_extra=None, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("ZNICZ_FAULTS", None)
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "znicz_tpu", str(module_path),
+         "--random-seed", "7"] + extra_args,
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    return proc
+
+
+def _parse_result(stdout: str):
+    for line in reversed(stdout.splitlines()):
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(f"no RESULT line in child output:\n{stdout}")
+
+
+class TestSupervisedAutoResumeE2E:
+    """The full subprocess acceptance: a REAL crash / SIGTERM, a REAL
+    supervisor, and the resumed trajectory golden vs the uninterrupted
+    run (4 jax child processes — the heaviest tests in the chaos
+    suite, kept tier-1 because they ARE the acceptance criterion)."""
+
+    @pytest.fixture(scope="class")
+    def child_module(self, tmp_path_factory):
+        path = tmp_path_factory.mktemp("mod") / "wf_mod.py"
+        path.write_text(_CHILD_MODULE)
+        return path
+
+    @pytest.fixture(scope="class")
+    def baseline(self, child_module, tmp_path_factory):
+        snap_dir = tmp_path_factory.mktemp("base_snaps")
+        proc = _run_child(
+            child_module,
+            ["--snapshot-dir", str(snap_dir), "--snapshot-interval", "1"],
+        )
+        assert proc.returncode == 0, proc.stderr
+        return _parse_result(proc.stdout)
+
+    def test_crash_under_supervisor_resumes_golden(
+        self, child_module, baseline, tmp_path
+    ):
+        snap_dir = tmp_path / "snaps"
+        proc = _run_child(
+            child_module,
+            [
+                "--snapshot-dir", str(snap_dir),
+                "--snapshot-interval", "1",
+                "--resume", "auto",
+                "--supervise",
+                "--max-restarts", "2",
+                "--restart-backoff", "0.1",
+            ],
+            # crash entering epoch 2 (fires 1+2 pass epochs 0/1);
+            # the restarted child re-arms but its 2 remaining epochs
+            # only consume the passthrough budget
+            env_extra={"ZNICZ_FAULTS": "train.crash:after=2:times=1"},
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        result = _parse_result(proc.stdout)
+        assert result == baseline  # exact resume: history AND params
+        sup = json.loads((snap_dir / "supervisor.json").read_text())
+        assert sup["restarts"] == 1
+        assert sup["history"][0]["exit_code"] not in (0, 75)
+        snaps = [
+            p for p in os.listdir(snap_dir) if ".pickle" in p
+        ]
+        assert snaps  # snapshots from both children present
+
+    def test_sigterm_mid_epoch_exits_75_then_resumes_golden(
+        self, child_module, baseline, tmp_path
+    ):
+        snap_dir = tmp_path / "snaps"
+        proc = _run_child(
+            child_module,
+            ["--snapshot-dir", str(snap_dir)],
+            # self-SIGTERM mid epoch 1 (3 steps/epoch)
+            env_extra={"ZNICZ_TEST_SIGTERM_STEP": "4"},
+        )
+        assert proc.returncode == 75, (proc.stdout, proc.stderr)
+        emergency = find_latest_valid(str(snap_dir))
+        assert emergency and "emergency" in emergency
+
+        proc2 = _run_child(
+            child_module,
+            ["--snapshot-dir", str(snap_dir), "--resume", "auto"],
+        )
+        assert proc2.returncode == 0, (proc2.stdout, proc2.stderr)
+        result = _parse_result(proc2.stdout)
+        assert result == baseline
+
+
+# ---------------------------------------------------------------------------
+class TestDoctorSelfHealingGate:
+    def _prom(self, **series) -> str:
+        reg = MetricsRegistry()
+        rb = series.pop("rollbacks", {})
+        if rb:
+            c = reg.counter(
+                pipeline_mod.ROLLBACKS_METRIC, "", ("reason",)
+            )
+            for reason, n in rb.items():
+                c.labels(reason=reason).inc(n)
+        for name, value in series.items():
+            if name.endswith("_total"):
+                reg.counter(name, "").inc(value)
+            else:
+                reg.gauge(name, "").set(value)
+        return reg.prometheus_text()
+
+    def test_recovery_summary_fields(self):
+        text = self._prom(
+            rollbacks={"non_finite_loss": 2},
+            **{
+                pipeline_mod.RESTARTS_METRIC: 1,
+                pipeline_mod.RESTART_BUDGET_METRIC: 3,
+                pipeline_mod.LOADER_RETRIES_METRIC: 5,
+                pipeline_mod.SNAPSHOT_FAILURES_METRIC: 1,
+            },
+        )
+        rec = PipelineAttribution.from_prometheus(
+            text
+        ).recovery_summary()
+        assert rec["rollbacks"] == {"non_finite_loss": 2}
+        assert rec["rollbacks_total"] == 2
+        assert rec["restarts"] == 1
+        assert rec["restart_budget"] == 3
+        assert rec["loader_retries"] == 5
+        assert rec["snapshot_failures"] == 1
+        assert not rec["looping"]
+
+    def test_doctor_exits_1_on_restart_loop(self, tmp_path, capsys):
+        from znicz_tpu.observability import doctor
+
+        prom = tmp_path / "m.prom"
+        prom.write_text(
+            self._prom(
+                **{
+                    pipeline_mod.RESTARTS_METRIC: 3,
+                    pipeline_mod.RESTART_BUDGET_METRIC: 3,
+                }
+            )
+        )
+        rc = doctor.main([str(prom)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "LOOPING" in out and "restart budget" in out
+
+    def test_doctor_exits_1_on_rollback_give_up(self, tmp_path, capsys):
+        from znicz_tpu.observability import doctor
+
+        prom = tmp_path / "m.prom"
+        prom.write_text(
+            self._prom(
+                rollbacks={"non_finite_loss": 2},
+                **{pipeline_mod.ROLLBACK_GIVE_UP_METRIC: 1},
+            )
+        )
+        rc = doctor.main([str(prom), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["recovery"]["looping"]
+        assert out["recovery"]["rollback_give_up"]
+
+    def test_doctor_healthy_with_counters_under_budget(
+        self, tmp_path, capsys
+    ):
+        from znicz_tpu.observability import doctor
+
+        prom = tmp_path / "m.prom"
+        prom.write_text(
+            self._prom(
+                rollbacks={"loss_spike": 1},
+                **{
+                    pipeline_mod.RESTARTS_METRIC: 1,
+                    pipeline_mod.RESTART_BUDGET_METRIC: 3,
+                    pipeline_mod.LOADER_RETRIES_METRIC: 2,
+                },
+            )
+        )
+        rc = doctor.main([str(prom)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "self-healing:" in out
+        assert "rollbacks 1" in out
+        assert "restarts 1/3" in out
+        assert "LOOPING" not in out
+
+    def test_doctor_smoke_on_real_rollback_run(self, tmp_path, capsys):
+        # tier-1 smoke (ISSUE satellite): a real run that rolled back
+        # writes metrics.prom; the doctor reports the counter and,
+        # absent an active anomaly window, still gates correctly
+        from znicz_tpu.observability import doctor
+        from znicz_tpu.services.web_status import StatusWriter
+
+        pol = RecoveryPolicy(max_rollbacks=3, perturb=False,
+                             lr_backoff=1.0)
+        wf = _mnist_workflow(
+            tmp_path / "snaps", snapshot_config={"interval": 1},
+            recovery=pol,
+        )
+        wf.services.append(StatusWriter(str(tmp_path / "status")))
+        wf.initialize(seed=77)
+        faults.inject("train.step_nan", flag=True, times=1, after=7)
+        wf.run()
+        assert pol.rollbacks_used == 1
+        rc = doctor.main(
+            [str(tmp_path / "status" / "metrics.prom"), "--json"]
+        )
+        out = json.loads(capsys.readouterr().out)
+        assert out["recovery"]["rollbacks_total"] >= 1
+        assert rc in (0, 1)  # 1 iff the anomaly window is still active
+
+
+class TestBenchDiffSelfHealingMarkers:
+    def test_direction_markers(self):
+        from znicz_tpu.utils.bench_diff import metric_direction
+
+        for name in (
+            "znicz_train_rollbacks_total",
+            "znicz_train_restarts_total",
+            "znicz_loader_retries_total",
+            "znicz_loader_skipped_batches_total",
+        ):
+            assert metric_direction(name, set(), set()) == "lower", name
+
+    def test_rise_from_zero_is_regression(self):
+        from znicz_tpu.utils.bench_diff import compare
+
+        rows, _ = compare(
+            {"znicz_train_rollbacks_total": 0.0},
+            {"znicz_train_rollbacks_total": 2.0},
+        )
+        assert rows[0]["regressed"]
+        rows, _ = compare(
+            {"znicz_train_restarts_total": 1.0},
+            {"znicz_train_restarts_total": 0.0},
+        )
+        assert not rows[0]["regressed"]
+
+
+class TestAutoResumeFallThrough:
+    def test_digest_valid_but_unloadable_snapshot_is_quarantined(
+        self, tmp_path
+    ):
+        # the sidecar digest is a byte check, not a decode check: a
+        # digest-valid file can still fail to unpickle.  --resume auto
+        # must quarantine it and fall through to an older snapshot
+        # instead of crash-looping the supervisor on the same file.
+        import argparse
+        import hashlib
+
+        from znicz_tpu.launcher import Launcher, make_parser
+
+        wf = _mnist_workflow(tmp_path, max_epochs=2,
+                             snapshot_config={"interval": 1,
+                                              "compress": False})
+        wf.initialize(seed=77)
+        wf.run()
+        good = find_latest_valid(str(tmp_path))
+        # forge a NEWER snapshot: garbage bytes with a MATCHING sidecar
+        bad = str(tmp_path / "StandardWorkflow_epoch9.pickle")
+        with open(bad, "wb") as f:
+            f.write(b"not a pickle at all")
+        with open(bad + ".sha256", "w") as f:
+            f.write(
+                hashlib.sha256(b"not a pickle at all").hexdigest()
+                + "  StandardWorkflow_epoch9.pickle  v1\n"
+            )
+        now = time.time() + 60
+        os.utime(bad, (now, now))
+        assert find_latest_valid(str(tmp_path)) == bad  # digest passes
+
+        prng.seed_all(77)
+        wf2 = _mnist_workflow(tmp_path, max_epochs=2)
+        args = make_parser().parse_args(
+            ["dummy.py", "--snapshot-dir", str(tmp_path),
+             "--resume", "auto", "--random-seed", "77"]
+        )
+        launcher = Launcher(args)
+        launcher.workflow = wf2
+        launcher._initialize_with_auto_resume()
+        assert launcher.args.snapshot == good  # fell through past bad
+        assert wf2.decision.epoch == 2
+
+
+class TestLauncherHelpers:
+    def test_child_argv_strips_supervisor_flags(self):
+        from znicz_tpu.launcher import _child_argv
+
+        argv = [
+            "wf.py", "--supervise", "--max-restarts", "5",
+            "--restart-backoff", "0.5", "--resume", "auto",
+            "--snapshot-dir", "/tmp/x", "--stop-after", "4",
+        ]
+        assert _child_argv(argv) == [
+            "wf.py", "--resume", "auto", "--snapshot-dir", "/tmp/x",
+            "--stop-after", "4",
+        ]
+        assert _child_argv(["a.py", "--max-restarts=7"]) == ["a.py"]
+
+    def test_exit_preempted_is_documented_75(self):
+        from znicz_tpu.launcher import EXIT_PREEMPTED
+
+        assert EXIT_PREEMPTED == 75
+
+    def test_restart_telemetry_export(self, monkeypatch):
+        from znicz_tpu.launcher import _export_restart_telemetry
+
+        before = _counter_total(pipeline_mod.RESTARTS_METRIC)
+        monkeypatch.setenv("ZNICZ_RESTARTS", "2")
+        monkeypatch.setenv("ZNICZ_RESTART_BUDGET", "5")
+        _export_restart_telemetry()
+        assert (
+            _counter_total(pipeline_mod.RESTARTS_METRIC) == before + 2
+        )
+        gauge = get_registry().metrics()[
+            pipeline_mod.RESTART_BUDGET_METRIC
+        ]
+        assert any(
+            c.value == 5.0 for c in gauge.children().values()
+        )

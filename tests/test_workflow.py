@@ -496,8 +496,19 @@ class TestSnapshotResume:
         st = TrainState.create([{"w": jnp.ones(2)}], jax.random.key(0))
         for e in range(5):
             snap.maybe_save(st, {}, epoch=e, improved=False)
-        files = sorted(p.name for p in tmp_path.iterdir())
+        files = sorted(
+            p.name for p in tmp_path.iterdir()
+            if p.name.endswith(".pickle")
+        )
         assert files == ["t_epoch3.pickle", "t_epoch4.pickle"]
+        # pruning removes the integrity sidecar along with its snapshot
+        sidecars = sorted(
+            p.name for p in tmp_path.iterdir()
+            if p.name.endswith(".sha256")
+        )
+        assert sidecars == [
+            "t_epoch3.pickle.sha256", "t_epoch4.pickle.sha256"
+        ]
 
     def test_snapshot_keep_limit_survives_restart(self, tmp_path):
         from znicz_tpu.nn.train_state import TrainState
@@ -510,7 +521,10 @@ class TestSnapshotResume:
         snap2 = Snapshotter(str(tmp_path), "t", interval=1, keep=2, compress=False)
         for e in range(3, 5):
             snap2.maybe_save(st, {}, epoch=e, improved=False)
-        files = sorted(p.name for p in tmp_path.iterdir())
+        files = sorted(
+            p.name for p in tmp_path.iterdir()
+            if p.name.endswith(".pickle")
+        )
         assert files == ["t_epoch3.pickle", "t_epoch4.pickle"]
 
     def test_state_roundtrip_preserves_key(self, tmp_path):

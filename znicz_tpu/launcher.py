@@ -15,17 +15,44 @@ Flag mapping from the reference (SURVEY.md 5.6):
                   --listen/--master-address: no master process exists,
                   SURVEY.md 3.4)
   --optimize      genetic hyperparameter search (veles --optimize)
+
+Self-healing additions (docs/TRAINING.md "Self-healing training"):
+``--resume auto`` resumes from the newest VALID snapshot in
+``--snapshot-dir`` (corrupt files skipped) or starts fresh;
+``--supervise`` runs the training command as a supervised child process
+and restarts it on crash with exponential backoff under a
+``--max-restarts`` budget, each restart resuming via ``--resume auto``;
+SIGTERM/SIGINT drain the in-flight step, write an emergency snapshot
+and exit with the documented code ``EXIT_PREEMPTED`` (75).
+
+Exit codes: 0 done; 75 gracefully preempted (emergency snapshot
+written — resume me); anything else: crash (the supervisor restarts
+while its budget lasts, then exits with the child's last code).
 """
 
 from __future__ import annotations
 
 import argparse
 import importlib.util
+import json
 import os
+import signal
+import subprocess
 import sys
+import time
 
 from znicz_tpu.core.config import root
 from znicz_tpu.core.logger import Logger, setup_logging
+
+# re-exported convenience: the documented graceful-preemption exit code
+from znicz_tpu.workflow.recovery import EXIT_PREEMPTED  # noqa: F401
+
+# supervisor-only flags, stripped from the child's argv (flag -> has value)
+_SUPERVISOR_FLAGS = {
+    "--supervise": False,
+    "--max-restarts": True,
+    "--restart-backoff": True,
+}
 
 
 def _load_module(path: str, name: str):
@@ -42,6 +69,11 @@ def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m znicz_tpu",
         description="TPU-native VELES/Znicz: run a workflow module",
+        # no prefix abbreviation: the supervisor strips its own flags
+        # from the child argv by EXACT spelling — an abbreviated
+        # --super reaching the child would recurse into a nested
+        # supervisor chain
+        allow_abbrev=False,
     )
     p.add_argument("workflow", help="path to the workflow module (.py)")
     p.add_argument(
@@ -53,6 +85,25 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--random-seed", type=int, default=None)
     p.add_argument("--snapshot", default=None,
                    help="resume training from this snapshot file")
+    p.add_argument("--resume", default=None, choices=["auto"],
+                   metavar="MODE",
+                   help="'auto': resume from the newest VALID snapshot "
+                        "in --snapshot-dir (corrupt/truncated files are "
+                        "skipped), or start fresh when none exists; "
+                        "overrides --snapshot")
+    p.add_argument("--supervise", action="store_true",
+                   help="run training as a supervised child process: "
+                        "restart it on crash with exponential backoff "
+                        "(resuming via --resume auto), forward "
+                        "SIGTERM/SIGINT, record restart history in "
+                        "supervisor.json")
+    p.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                   help="supervisor restart budget (default 3); past it "
+                        "the supervisor exits with the child's last code")
+    p.add_argument("--restart-backoff", type=float, default=1.0,
+                   metavar="SECONDS",
+                   help="initial restart backoff, doubled per restart "
+                        "and capped at 60s (default 1.0; 0 disables)")
     p.add_argument("--snapshot-interval", type=int, default=None,
                    metavar="K",
                    help="also snapshot every K epochs (composes with "
@@ -188,6 +239,64 @@ class Launcher(Logger):
         self.workflow = workflow_cls(*wf_args, **wf_kwargs)
         return self.workflow
 
+    def _resolve_auto_resume(self, exclude=()):
+        """``--resume auto`` -> the newest valid snapshot path (or None
+        for a fresh start).  Resolved HERE, once the workflow exists,
+        so the search is scoped to the workflow's own snapshot prefix —
+        a shared directory must never hand back another model's
+        checkpoint (a shape-mismatch crash loop under --supervise)."""
+        from znicz_tpu.workflow.snapshotter import find_latest_valid
+
+        snapshotter = getattr(self.workflow, "snapshotter", None)
+        directory = self.args.snapshot_dir or getattr(
+            snapshotter, "directory", None
+        )
+        if not directory:
+            raise SystemExit(
+                "--resume auto needs --snapshot-dir (or a workflow "
+                "snapshotter) to know where to look"
+            )
+        found = find_latest_valid(
+            directory,
+            prefix=getattr(snapshotter, "prefix", None),
+            exclude=exclude,
+        )
+        if found:
+            self.info("--resume auto: resuming from %s", found)
+        else:
+            self.info(
+                "--resume auto: no valid snapshot under %s; starting "
+                "fresh", directory,
+            )
+        return found
+
+    def _initialize_with_auto_resume(self, **kwargs) -> None:
+        """Initialize, quarantining auto-resolved snapshots that pass
+        verification (a digest check) but still fail to LOAD — e.g. a
+        pickle referencing a since-renamed class.  Falling through to
+        the next older snapshot keeps ``--supervise`` from burning its
+        whole restart budget on one bad file."""
+        from znicz_tpu.workflow.snapshotter import SnapshotCorruptError
+
+        tried: set = set()
+        while True:
+            self.args.snapshot = self._resolve_auto_resume(exclude=tried)
+            try:
+                self.workflow.initialize(
+                    seed=self.args.random_seed,
+                    snapshot=self.args.snapshot,
+                    **kwargs,
+                )
+                return
+            except (SnapshotCorruptError, ValueError):
+                if not self.args.snapshot:
+                    raise  # a fresh start failed: not a snapshot issue
+                self.logger.exception(
+                    "--resume auto: %s failed to load; trying an "
+                    "older snapshot", self.args.snapshot,
+                )
+                tried.add(self.args.snapshot)
+
     def main(self, **kwargs):
         """Initialize and run the loaded workflow."""
         if self.workflow is None:
@@ -206,9 +315,22 @@ class Launcher(Logger):
                 validate_exportable(self.workflow.model)
             except ValueError as e:
                 raise SystemExit(f"--export: {e}") from None
-        self.workflow.initialize(
-            seed=self.args.random_seed, snapshot=self.args.snapshot, **kwargs
-        )
+        if self.args.resume == "auto":
+            self._initialize_with_auto_resume(**kwargs)
+        else:
+            self.workflow.initialize(
+                seed=self.args.random_seed, snapshot=self.args.snapshot,
+                **kwargs,
+            )
+        if (
+            getattr(self.workflow, "snapshotter", None) is not None
+            and hasattr(self.workflow, "enable_emergency_snapshots")
+            and not (self.args.dry_run or self.args.evaluate)
+        ):
+            # CLI runs own their process and have the SIGTERM/SIGINT
+            # handlers installed: retain each epoch's start state so a
+            # mid-epoch preemption snapshots consistently
+            self.workflow.enable_emergency_snapshots()
         if self.args.dry_run:
             self.info("dry run: workflow initialized, skipping run()")
             return None
@@ -250,11 +372,217 @@ class Launcher(Logger):
         self.info("exported trained model to %s", self.args.export)
 
 
+def _child_argv(argv) -> list:
+    """The supervised child's argv: the supervisor's own flags stripped,
+    everything else (including ``--resume auto``, so every restart
+    re-resolves the newest valid snapshot) passed through."""
+    out, i = [], 0
+    while i < len(argv):
+        a = argv[i]
+        base = a.split("=", 1)[0]
+        if base in _SUPERVISOR_FLAGS:
+            i += 2 if _SUPERVISOR_FLAGS[base] and "=" not in a else 1
+            continue
+        out.append(a)
+        i += 1
+    return out
+
+
+def _atomic_json(path: str, obj) -> None:
+    from znicz_tpu.services.web_status import _atomic_write
+
+    _atomic_write(path, json.dumps(obj, indent=2))
+
+
+def supervise(args: argparse.Namespace, argv) -> int:
+    """The supervised auto-resume loop (docs/TRAINING.md).
+
+    Runs ``python -m znicz_tpu <argv minus supervisor flags>`` as a
+    child; exit 0 ends the run, a crash restarts it with exponential
+    backoff while the ``--max-restarts`` budget lasts (each child gets
+    ``ZNICZ_RESTARTS``/``ZNICZ_RESTART_BUDGET`` in its environment so
+    its own ``/metrics`` carries ``znicz_train_restarts_total``), and a
+    SIGTERM/SIGINT to the supervisor is forwarded to the child — whose
+    graceful exit code (75) is then passed through instead of counting
+    as a crash.  A child that exits 75 WITHOUT the supervisor being
+    signalled (an externally-preempted child) is restarted like a
+    crash: that is the auto-resume.  Restart history is written to
+    ``supervisor.json`` next to the snapshots."""
+    log = Logger()
+    if args.resume != "auto" and not args.snapshot:
+        log.warning(
+            "--supervise without --resume auto: a restarted child "
+            "starts FRESH instead of resuming from the newest snapshot"
+        )
+    child_cmd = [sys.executable, "-m", "znicz_tpu"] + _child_argv(argv)
+    history: list = []
+    state = {"proc": None, "signalled": None}
+    history_dir = args.snapshot_dir or "."
+    os.makedirs(history_dir, exist_ok=True)
+    history_path = os.path.join(history_dir, "supervisor.json")
+
+    def _forward(signum, frame):
+        state["signalled"] = signum
+        proc = state["proc"]
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signum)
+            # child already reaped: nothing to forward to
+            except OSError:  # znicz-check: disable=ZNC008
+                pass
+
+    prev = {}
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[signum] = signal.signal(signum, _forward)
+        # non-main thread (tests): forwarding off
+        except ValueError:  # znicz-check: disable=ZNC008
+            pass
+    restarts = 0
+    try:
+        while True:
+            env = dict(os.environ)
+            env["ZNICZ_RESTARTS"] = str(restarts)
+            env["ZNICZ_RESTART_BUDGET"] = str(args.max_restarts)
+            log.info(
+                "supervisor: starting child (restart %d/%d): %s",
+                restarts, args.max_restarts, " ".join(child_cmd),
+            )
+            # own session: the terminal's Ctrl+C must not ALSO hit the
+            # child directly — a doubled SIGINT would trip the child's
+            # second-signal force-exit before the emergency snapshot.
+            # The supervisor's forward is the one delivery.
+            state["proc"] = subprocess.Popen(
+                child_cmd, env=env, start_new_session=True
+            )
+            rc = state["proc"].wait()
+            history.append(
+                {
+                    "restart": restarts,
+                    "exit_code": rc,
+                    "signalled": state["signalled"],
+                    # timestamp, not a duration
+                    "unix": time.time(),  # znicz-check: disable=ZNC007
+                }
+            )
+            try:
+                _atomic_json(
+                    history_path,
+                    {
+                        "restarts": restarts,
+                        "max_restarts": args.max_restarts,
+                        "history": history,
+                    },
+                )
+            except OSError:
+                log.warning("supervisor.json write failed", exc_info=True)
+            if rc == 0 or state["signalled"] is not None:
+                # done, or the operator stopped US — pass the child's
+                # code through (75 = graceful preemption with an
+                # emergency snapshot on disk)
+                return rc
+            if restarts >= args.max_restarts:
+                log.error(
+                    "supervisor: restart budget (%d) spent; child exit "
+                    "%d — giving up", args.max_restarts, rc,
+                )
+                return rc
+            restarts += 1
+            delay = (
+                min(args.restart_backoff * 2 ** (restarts - 1), 60.0)
+                if args.restart_backoff > 0
+                else 0.0
+            )
+            log.warning(
+                "supervisor: child exited %d; restart %d/%d in %.1fs",
+                rc, restarts, args.max_restarts, delay,
+            )
+            if delay:
+                time.sleep(delay)
+            if state["signalled"] is not None:
+                # a stop request landed while no child was alive (the
+                # backoff window): honor it instead of spawning a
+                # fresh child to train for hours after the operator
+                # asked us to stop
+                log.info(
+                    "supervisor: stop requested during backoff; "
+                    "not restarting"
+                )
+                return rc
+    finally:
+        for signum, handler in prev.items():
+            try:
+                signal.signal(signum, handler)
+            # non-main thread: nothing was installed to restore
+            except ValueError:  # znicz-check: disable=ZNC008
+                pass
+
+
+def _install_stop_handlers(launcher: Launcher) -> bool:
+    """SIGTERM/SIGINT -> Workflow.request_stop(): drain the in-flight
+    step, write the emergency snapshot, exit EXIT_PREEMPTED.  A second
+    signal (or one before the workflow exists) exits immediately."""
+
+    def _handler(signum, frame):
+        wf = launcher.workflow
+        if (
+            wf is not None
+            and hasattr(wf, "request_stop")
+            and not getattr(wf, "_preempt_requested", False)
+        ):
+            wf.request_stop()
+        else:
+            raise SystemExit(EXIT_PREEMPTED)
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+        return True
+    except ValueError:  # not the main thread (embedded/test use)
+        return False
+
+
+def _export_restart_telemetry() -> None:
+    """Surface the supervisor-provided restart count/budget in THIS
+    process's registry, so metrics.prom / status.json / the aggregator
+    (and znicz-doctor's restart-loop gate) see them."""
+    restarts = os.environ.get("ZNICZ_RESTARTS")
+    budget = os.environ.get("ZNICZ_RESTART_BUDGET")
+    if not restarts and not budget:
+        return
+    from znicz_tpu import observability
+    from znicz_tpu.observability import pipeline as _pipeline
+
+    try:
+        n = int(restarts or 0)
+        if n:
+            observability.counter(
+                _pipeline.RESTARTS_METRIC,
+                "supervised training restarts preceding this process",
+            ).inc(n)
+        if budget:
+            observability.gauge(
+                _pipeline.RESTART_BUDGET_METRIC,
+                "supervisor restart budget (--max-restarts)",
+            ).set(float(int(budget)))
+    except ValueError:
+        Logger().warning(
+            "malformed ZNICZ_RESTARTS/ZNICZ_RESTART_BUDGET ignored"
+        )
+
+
 def run_args(argv=None) -> Launcher:
     args = make_parser().parse_args(argv)
     # the CLI owns its process: force-install so --verbose wins even if
     # an imported library already touched the root logger
     setup_logging(10 if args.verbose else 20, force=True)
+    if args.supervise:
+        # the supervisor never builds a workflow itself — it loops the
+        # SAME command (minus supervisor flags) as a child process
+        raise SystemExit(
+            supervise(args, list(sys.argv[1:] if argv is None else argv))
+        )
+    _export_restart_telemetry()
     if args.device:
         # jax is imported by the package before CLI parsing and deployment
         # sitecustomize hooks may force a platform config, so an explicit
@@ -335,7 +663,17 @@ def run_args(argv=None) -> Launcher:
             module.run(launcher.load, launcher.main)
             launcher.result = opt_result  # keep the search summary
         return launcher
-    module.run(launcher.load, launcher.main)
+    from znicz_tpu.workflow.recovery import TrainingPreempted
+
+    _install_stop_handlers(launcher)
+    try:
+        module.run(launcher.load, launcher.main)
+    except TrainingPreempted as exc:
+        Logger().info(
+            "preempted gracefully (snapshot: %s); exiting %d",
+            exc.snapshot_path, EXIT_PREEMPTED,
+        )
+        raise SystemExit(EXIT_PREEMPTED) from None
     return launcher
 
 

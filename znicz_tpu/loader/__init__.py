@@ -8,8 +8,16 @@ masked inside the jitted step instead of triggering recompilation
 (SURVEY.md §7 "Hard parts").
 """
 
-from znicz_tpu.loader.base import TRAIN, VALID, TEST, Loader, Minibatch  # noqa: F401
+from znicz_tpu.loader.base import (  # noqa: F401
+    TRAIN,
+    VALID,
+    TEST,
+    Loader,
+    LoaderFetchError,
+    Minibatch,
+)
 from znicz_tpu.loader.fullbatch import FullBatchLoader  # noqa: F401
+from znicz_tpu.loader.prefetch import PrefetchProducerError  # noqa: F401
 from znicz_tpu.loader.image import ImageDirectoryLoader  # noqa: F401
 from znicz_tpu.loader.imagenet import ImageNetLoader, pack_image_dir  # noqa: F401
 from znicz_tpu.loader import datasets  # noqa: F401

@@ -11,14 +11,46 @@ valid rows, because XLA recompiles on shape change.
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import Dict, Iterator, NamedTuple, Optional, Sequence
 
 import numpy as np
 
 from znicz_tpu.core import prng
+from znicz_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
 
 TRAIN, VALID, TEST = "train", "valid", "test"
 SPLITS = (TRAIN, VALID, TEST)
+
+
+class LoaderFetchError(RuntimeError):
+    """A minibatch fetch (``Loader.fill``) kept failing past the retry
+    budget — the typed, consumer-visible form of a flaky data source
+    (docs/TRAINING.md "Self-healing training")."""
+
+
+def _loader_retry_counter():
+    from znicz_tpu import observability
+    from znicz_tpu.observability import pipeline as _pipeline
+
+    return observability.counter(
+        _pipeline.LOADER_RETRIES_METRIC,
+        "minibatch fetch attempts retried after a transient failure",
+    )
+
+
+def _loader_skipped_counter():
+    from znicz_tpu import observability
+    from znicz_tpu.observability import pipeline as _pipeline
+
+    return observability.counter(
+        _pipeline.LOADER_SKIPPED_METRIC,
+        "minibatches dropped after exhausting fetch retries "
+        "(skip_bad_batches=True)",
+    )
 
 
 class Minibatch(NamedTuple):
@@ -42,11 +74,24 @@ class Loader:
         shuffle: bool = True,
         balanced: bool = False,
         rand_name: str = "loader",
+        fetch_retries: int = 2,
+        fetch_backoff_s: float = 0.05,
+        skip_bad_batches: bool = False,
     ):
         self.max_minibatch_size = int(minibatch_size)
         self.shuffle = shuffle
         self.balanced = balanced  # spread classes evenly across minibatches
         self.rand_name = rand_name
+        # fault tolerance (docs/TRAINING.md): fill(indices, split) is a
+        # pure function of its indices, so a transient failure (network
+        # FS hiccup, flaky decoder) is retried with bounded backoff;
+        # past the budget the batch is either SKIPPED (counted, masked
+        # out of the epoch — skip_bad_batches=True) or surfaces as the
+        # typed LoaderFetchError.  The loader.fetch_flaky fault point
+        # fires before each attempt (CI fixture for both paths).
+        self.fetch_retries = int(fetch_retries)
+        self.fetch_backoff_s = float(fetch_backoff_s)
+        self.skip_bad_batches = bool(skip_bad_batches)
         self._order: Dict[str, np.ndarray] = {}
         self.epoch_number = 0
         # multi-host sample shard (Loader.set_process_shard): this process
@@ -130,6 +175,15 @@ class Loader:
                 f"minibatch_size {self.max_minibatch_size} not divisible "
                 f"by process_count {count}"
             )
+        if count > 1 and self.skip_bad_batches:
+            # a skip is per-process: one process dropping a batch while
+            # its peers dispatch the step desynchronizes the collective
+            # and hangs the fleet — fail loudly at configuration time
+            raise ValueError(
+                "skip_bad_batches=True cannot combine with multi-host "
+                "training (a per-process skip desynchronizes step "
+                "counts across processes); use fetch_retries instead"
+            )
         self.process_index = int(index)
         self.process_count = int(count)
 
@@ -202,8 +256,44 @@ class Loader:
             self._validate_batch_indices(idx, split)
             if self.process_count > 1:
                 idx, mask = idx[lo:hi], mask[lo:hi]
-            mb = self.fill(idx, split)
+            mb = self._fill_with_retry(idx, split)
+            if mb is None:  # skipped bad batch (counted)
+                continue
             yield mb._replace(mask=mask, indices=idx)
+
+    def _fill_with_retry(self, idx: np.ndarray, split: str):
+        """``fill`` behind the retry/skip ladder.  Returns None for a
+        skipped batch (``skip_bad_batches``); raises the typed
+        :class:`LoaderFetchError` once the retry budget is spent."""
+        attempt = 0
+        while True:
+            try:
+                faults.fire("loader.fetch_flaky")
+                return self.fill(idx, split)
+            except Exception as exc:
+                if attempt >= self.fetch_retries:
+                    if self.skip_bad_batches:
+                        _loader_skipped_counter().inc()
+                        logger.warning(
+                            "skipping bad %s batch after %d attempt(s): "
+                            "%s", split, attempt + 1, exc,
+                        )
+                        return None
+                    raise LoaderFetchError(
+                        f"fetching a {split} minibatch failed "
+                        f"{attempt + 1} time(s): {exc}"
+                    ) from exc
+                attempt += 1
+                _loader_retry_counter().inc()
+                logger.warning(
+                    "%s minibatch fetch failed (attempt %d/%d): %s — "
+                    "retrying", split, attempt, self.fetch_retries + 1,
+                    exc,
+                )
+                if self.fetch_backoff_s > 0:
+                    time.sleep(
+                        self.fetch_backoff_s * (2 ** (attempt - 1))
+                    )
 
     def _validate_batch_indices(self, idx: np.ndarray, split: str) -> None:
         """Hook: loaders with placement invariants on the FULL (pre-

@@ -36,6 +36,17 @@ T = TypeVar("T")
 _SENTINEL = object()
 
 
+class PrefetchProducerError(RuntimeError):
+    """The prefetch producer thread died without delivering its
+    end-of-epoch sentinel (or the error that killed it) — the typed,
+    consumer-visible form of a dead producer.  Ordinary producer
+    exceptions re-raise AS THEMSELVES at the consumer's next pull; this
+    only fires when the thread is gone and nothing explains why (e.g.
+    it never started), turning what used to be an unbounded ``q.get()``
+    hang into a diagnosis (the ZNC013 "a thread death must be a typed
+    event" contract)."""
+
+
 def prefetch(
     iterable: Iterable[T],
     depth: int = 2,
@@ -147,7 +158,21 @@ def prefetch(
     try:
         while True:
             t0 = time.perf_counter()
-            item = q.get()
+            # bounded get with a liveness check: a producer thread that
+            # died without its sentinel (hard kill, never started) must
+            # become a typed error, not an unbounded q.get() hang
+            while True:
+                try:
+                    item = q.get(timeout=0.5)
+                    break
+                except queue.Empty:  # znicz-check: disable=ZNC008
+                    if not t.is_alive() and q.empty():
+                        if error:
+                            raise error[0]
+                        raise PrefetchProducerError(
+                            "prefetch producer thread died without "
+                            "delivering a sentinel or an error"
+                        )
             wait.observe(time.perf_counter() - t0)
             if item is _SENTINEL:
                 if error:

@@ -20,8 +20,14 @@ Exit codes: **0** healthy (including "no training data in this
 capture" — absence of evidence is not an incident), **1** an anomaly
 is ACTIVE (``znicz_train_anomaly_active`` > 0 — the flight recorder
 fired within its active window; the ring itself lives in
-``status.json``), **2** usage / unreadable source / malformed
-exposition — the ``tools/znicz-bench-diff`` convention.
+``status.json``) OR the run is **restart-looping** (its supervised
+restart budget is spent — ``znicz_train_restarts_total`` >=
+``znicz_train_restart_budget`` — or a rollback gave up:
+``znicz_train_rollback_give_up``), **2** usage / unreadable source /
+malformed exposition — the ``tools/znicz-bench-diff`` convention.
+The self-healing counters (rollbacks by reason, restarts, loader
+retries/skips, snapshot write failures) print on their own line and
+ride the ``--json`` output as ``"recovery"``.
 """
 
 from __future__ import annotations
@@ -45,7 +51,46 @@ def _fmt_bandwidth(bps: Optional[float]) -> str:
     return f"H2D ~{bps / 1e6:.1f} MB/s"
 
 
-def _render(att: dict, anomalies: dict) -> str:
+def _render_recovery(rec: dict) -> List[str]:
+    """The self-healing line(s): silent when nothing ever fired."""
+    lines: List[str] = []
+    parts: List[str] = []
+    if rec["rollbacks_total"]:
+        by_reason = ", ".join(
+            f"{k}={v}" for k, v in rec["rollbacks"].items()
+        )
+        parts.append(f"rollbacks {rec['rollbacks_total']} ({by_reason})")
+    if rec["restarts"]:
+        budget = (
+            f"/{rec['restart_budget']}"
+            if rec["restart_budget"] is not None
+            else ""
+        )
+        parts.append(f"restarts {rec['restarts']}{budget}")
+    if rec["loader_retries"]:
+        parts.append(f"loader retries {rec['loader_retries']}")
+    if rec["loader_skipped_batches"]:
+        parts.append(
+            f"skipped batches {rec['loader_skipped_batches']}"
+        )
+    if rec["snapshot_failures"]:
+        parts.append(f"snapshot failures {rec['snapshot_failures']}")
+    if parts:
+        lines.append("self-healing: " + "; ".join(parts))
+    if rec["looping"]:
+        why = (
+            "rollback gave up"
+            if rec["rollback_give_up"]
+            else "restart budget spent"
+        )
+        lines.append(
+            f"self-healing: LOOPING ({why}) — this run is not healing "
+            "itself; intervene"
+        )
+    return lines
+
+
+def _render(att: dict, anomalies: dict, recovery: dict) -> str:
     lines: List[str] = []
     if att["verdict"] == "no-data":
         lines.append(
@@ -88,6 +133,7 @@ def _render(att: dict, anomalies: dict) -> str:
         )
     else:
         lines.append("anomalies: none")
+    lines.extend(_render_recovery(recovery))
     if att.get("suggestion"):
         lines.append(f"suggest: {att['suggestion']}")
     return "\n".join(lines)
@@ -129,6 +175,7 @@ def main(argv=None) -> int:
         )
         att = att_src.attribution()
         anomalies = att_src.anomaly_summary()
+        recovery = att_src.recovery_summary()
     except (OSError, ValueError) as exc:
         print(f"znicz-doctor: {exc}", file=sys.stderr)
         return 2
@@ -140,12 +187,13 @@ def main(argv=None) -> int:
                     "instance": instance,
                     **att,
                     "anomalies": anomalies,
+                    "recovery": recovery,
                 }
             )
         )
     else:
-        print(_render(att, anomalies))
-    return 1 if anomalies["active"] else 0
+        print(_render(att, anomalies, recovery))
+    return 1 if anomalies["active"] or recovery["looping"] else 0
 
 
 if __name__ == "__main__":

@@ -74,6 +74,18 @@ ANOMALY_TOTAL_METRIC = "znicz_train_anomalies_total"
 LAST_LOSS_METRIC = "znicz_train_last_loss"
 LAST_GRAD_METRIC = "znicz_train_last_grad_norm"
 
+# self-healing surfaces (docs/TRAINING.md): the training tier's
+# detect->recover loop.  Defined HERE (stdlib-pure) so both the
+# producers (workflow/recovery.py, launcher.py, loader/base.py) and the
+# doctor's readout speak one name per signal.
+ROLLBACKS_METRIC = "znicz_train_rollbacks_total"
+ROLLBACK_GIVE_UP_METRIC = "znicz_train_rollback_give_up"
+RESTARTS_METRIC = "znicz_train_restarts_total"
+RESTART_BUDGET_METRIC = "znicz_train_restart_budget"
+LOADER_RETRIES_METRIC = "znicz_loader_retries_total"
+LOADER_SKIPPED_METRIC = "znicz_loader_skipped_batches_total"
+SNAPSHOT_FAILURES_METRIC = "znicz_train_snapshot_failures_total"
+
 # the families a warm-up window reset clears (bench/tests exclude the
 # first epoch's compile stall from the attribution they report)
 WINDOW_METRICS = (
@@ -451,4 +463,39 @@ class PipelineAttribution:
             "total": int(sum(counts.values())),
             "last_loss": self._gauge_max(LAST_LOSS_METRIC),
             "last_grad_norm": self._gauge_max(LAST_GRAD_METRIC),
+        }
+
+    def recovery_summary(self) -> dict:
+        """The self-healing view of the same capture: rollback /
+        restart / loader-retry counters plus the give-up signals
+        ``znicz-doctor`` gates on.  ``looping`` is True when the run
+        has burned its whole restart budget (the supervisor is about
+        to — or already did — give up) or a rollback gave up: both are
+        "this run is not healing itself" incidents, the doctor's
+        exit-1 condition."""
+        rollbacks: Dict[str, int] = {}
+        for name, labels, value in self._samples:
+            if name == ROLLBACKS_METRIC and value > 0:
+                key = labels.get("reason", "unknown")
+                rollbacks[key] = rollbacks.get(key, 0) + int(value)
+        restarts = int(self._sum(RESTARTS_METRIC))
+        budget = self._gauge_max(RESTART_BUDGET_METRIC)
+        give_up = bool(self._gauge_max(ROLLBACK_GIVE_UP_METRIC))
+        looping = give_up or (
+            budget is not None and budget > 0 and restarts >= budget
+        )
+        return {
+            "rollbacks": dict(sorted(rollbacks.items())),
+            "rollbacks_total": sum(rollbacks.values()),
+            "rollback_give_up": give_up,
+            "restarts": restarts,
+            "restart_budget": int(budget) if budget is not None else None,
+            "loader_retries": int(self._sum(LOADER_RETRIES_METRIC)),
+            "loader_skipped_batches": int(
+                self._sum(LOADER_SKIPPED_METRIC)
+            ),
+            "snapshot_failures": int(
+                self._sum(SNAPSHOT_FAILURES_METRIC)
+            ),
+            "looping": looping,
         }

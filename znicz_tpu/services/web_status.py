@@ -105,6 +105,9 @@ class StatusWriter:
             # znicz-doctor derives from /metrics, epoch-fresh here
             "anomalies": self._anomalies(workflow),
             "pipeline": self._attribution(),
+            # self-healing readout: rollback events/budget + lr backoff
+            # (docs/TRAINING.md; restart counters ride "metrics")
+            "recovery": self._recovery(workflow),
         }
         _atomic_write(
             os.path.join(self.directory, "status.json"),
@@ -134,6 +137,19 @@ class StatusWriter:
         except Exception:
             logger.debug("anomaly report failed", exc_info=True)
             return {"active": False, "total": 0, "ring": []}
+
+    @staticmethod
+    def _recovery(workflow) -> dict:
+        """The workflow's recovery-policy readout (empty when no policy
+        is wired).  Status must never break training."""
+        policy = getattr(workflow, "recovery", None)
+        if policy is None:
+            return {"rollbacks_used": 0, "gave_up": False, "events": []}
+        try:
+            return policy.report()
+        except Exception:
+            logger.debug("recovery report failed", exc_info=True)
+            return {"rollbacks_used": 0, "gave_up": False, "events": []}
 
     @staticmethod
     def _attribution() -> dict:

@@ -17,7 +17,8 @@ Direction is inferred per metric name — throughput-shaped names
 ``*_acceptance_rate``, ``*_bytes_per_second``, ``mfu``...) regress
 when they DROP; latency/cost-shaped names (``*ttft*``, ``*latency*``,
 ``*_ms``, ``*compile*``, ``preemptions``, ``retries``, ``failed``,
-``*_bound_frac``...) regress when they RISE.  Override per metric with ``--lower NAME`` /
+``*_bound_frac``, ``*_rollbacks_total``, ``*_restarts_total``...)
+regress when they RISE.  Override per metric with ``--lower NAME`` /
 ``--higher NAME``; scope with ``--only PREFIX``; tune with
 ``--threshold FRAC`` (default 0.10 — a 10% move).
 
@@ -40,6 +41,9 @@ _LOWER_MARKERS = (
     "ttft", "latency", "_ms", "step_ms", "wait", "compile",
     "preemption", "retries", "eviction", "failed", "error", "shed",
     "deadline", "cancelled", "queue_age", "lag", "_bound_frac",
+    # self-healing: a round that rolled back / restarted / skipped
+    # more than the baseline regressed, whatever its throughput says
+    "rollback", "restart", "skipped",
 )
 _HIGHER_MARKERS = (
     "per_sec", "per_s", "rps", "hit_rate", "mfu", "concurrency",

@@ -44,6 +44,26 @@ point                     effect when armed
                           region (arm with ``delay=`` for a slow
                           host->device link: the h2d-bound
                           attribution fixture)
+``loader.fetch_flaky``    raises before each ``Loader.fill`` attempt (a
+                          flaky data source: the bounded-retry /
+                          skip-bad-batch ladder; arm with ``times=k``
+                          so the k+1-th attempt succeeds)
+``snapshot.write``        raises inside the snapshot file write, before
+                          the atomic replace (disk failure: the
+                          previous snapshot stays intact, ``maybe_save``
+                          counts + continues)
+``snapshot.load``         raises at the top of ``load_snapshot`` (an
+                          unreadable checkpoint: ``find_latest_valid``
+                          skips it, resume lands on an older one)
+``train.step_nan``        behavioral: the workflow feeds the anomaly
+                          detector a NaN loss for one step (arm with
+                          ``flag=True``; ``after=k`` picks the step) —
+                          the rollback path's fixture without poisoning
+                          device state
+``train.crash``           raises at the top of ``Workflow.run_epoch``
+                          (a hard process crash at an epoch boundary;
+                          arm with ``after=k`` to crash entering epoch
+                          k: the supervised auto-resume fixture)
 ========================  ==================================================
 
 Arming::

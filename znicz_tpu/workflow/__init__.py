@@ -9,7 +9,19 @@ compiled as a single XLA program [SURVEY.md §7 "Design stance"].
 """
 
 from znicz_tpu.workflow.model import Model, build  # noqa: F401
-from znicz_tpu.workflow.snapshotter import Snapshotter  # noqa: F401
+from znicz_tpu.workflow.recovery import (  # noqa: F401
+    EXIT_PREEMPTED,
+    RecoveryPolicy,
+    RollbackExhaustedError,
+    TrainingPreempted,
+)
+from znicz_tpu.workflow.snapshotter import (  # noqa: F401
+    SnapshotCorruptError,
+    Snapshotter,
+    SnapshotWriteError,
+    find_latest_valid,
+    load_snapshot,
+)
 from znicz_tpu.workflow.workflow import Workflow  # noqa: F401
 from znicz_tpu.workflow.standard import StandardWorkflow  # noqa: F401
 from znicz_tpu.workflow.unsupervised import (  # noqa: F401

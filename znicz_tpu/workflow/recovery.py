@@ -1,0 +1,181 @@
+"""Training self-healing policy: anomaly-triggered rollback + typed exits.
+
+PR 13's flight recorder made training failures *visible* — a NaN loss
+rings the :class:`~znicz_tpu.observability.anomaly.StepAnomalyDetector`
+and ``znicz-doctor`` exits 1 — but nothing *acted* on a verdict: the
+run kept burning steps on poisoned state.  This module is the acting
+half (docs/TRAINING.md "Self-healing training"):
+
+* :class:`RecoveryPolicy` — consumes the detector's typed verdicts and
+  decides when the workflow rolls back to its last good snapshot, how
+  the replay is perturbed (advance the shuffle stream and/or scale the
+  learning rate down) and when to give up (bounded rollback budget ->
+  typed :class:`RollbackExhaustedError`).
+* :class:`TrainingPreempted` — the control-flow exception a
+  SIGTERM/SIGINT-initiated graceful stop raises after the in-flight
+  step drained and the emergency snapshot was written; the launcher
+  maps it to :data:`EXIT_PREEMPTED`.
+
+The policy object is host-side bookkeeping only (no jax): the rollback
+mechanics — state restore, PRNG/loader/decision rewind — live in
+:class:`~znicz_tpu.workflow.workflow.Workflow`, which re-feeds the
+ALREADY-COMPILED train step, so recovery adds zero new XLA programs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from znicz_tpu import observability
+from znicz_tpu.observability import anomaly as _anomaly
+from znicz_tpu.observability import pipeline as _pipeline
+
+# documented process exit code for a graceful preemption (SIGTERM/
+# SIGINT): "the run was interrupted, an emergency snapshot exists,
+# resume me" — distinct from 0 (done) and 1 (crash).  75 is EX_TEMPFAIL
+# ("temporary failure, retry"), exactly the supervisor's restart hint.
+EXIT_PREEMPTED = 75
+
+# verdict types that mean "the train state itself is poisoned" — a
+# rollback is the only fix (continuing trains garbage)
+NON_FINITE_TYPES = (
+    _anomaly.NON_FINITE_LOSS,
+    _anomaly.NON_FINITE_GRAD,
+)
+
+
+class RollbackExhaustedError(RuntimeError):
+    """The recovery policy gave up: the rollback budget is spent (or no
+    valid snapshot exists to roll back to).  The run is not healing
+    itself — surface to the operator instead of looping."""
+
+
+class TrainingPreempted(Exception):
+    """Graceful-stop control flow: raised by the workflow after a
+    requested stop drained the in-flight step and wrote the emergency
+    snapshot.  ``snapshot_path`` is None when no snapshotter was
+    configured (nothing durable could be written)."""
+
+    def __init__(self, message: str, snapshot_path: Optional[str] = None):
+        super().__init__(message)
+        self.snapshot_path = snapshot_path
+
+
+class RecoveryPolicy:
+    """When/how training rolls back to the last good snapshot.
+
+    ``max_rollbacks``: total rollback budget for the run; exceeding it
+    raises :class:`RollbackExhaustedError` (typed give-up, surfaced as
+    the ``znicz_train_rollback_give_up`` gauge).
+    ``lr_backoff``: multiply the effective learning-rate scale by this
+    on every rollback (1.0 = keep the schedule; the scale composes with
+    the workflow's ``lr_policy``).
+    ``perturb``: advance the loader's shuffle stream after the restore
+    so the replayed data window differs — a data-order-dependent blowup
+    doesn't deterministically recur.  Leave False (with
+    ``lr_backoff=1.0``) for byte-exact replay, e.g. golden tests.
+    ``rollback_on_spike``: 0 disables; N > 0 also rolls back after N
+    ``loss_spike`` verdicts since the last rollback (non-finite
+    verdicts always trigger).
+    """
+
+    def __init__(
+        self,
+        *,
+        max_rollbacks: int = 2,
+        lr_backoff: float = 0.5,
+        perturb: bool = True,
+        rollback_on_spike: int = 0,
+    ):
+        if max_rollbacks < 1:
+            raise ValueError("max_rollbacks must be >= 1")
+        if not 0.0 < lr_backoff <= 1.0:
+            raise ValueError("lr_backoff must be in (0, 1]")
+        if rollback_on_spike < 0:
+            raise ValueError("rollback_on_spike must be >= 0")
+        self.max_rollbacks = int(max_rollbacks)
+        self.lr_backoff = float(lr_backoff)
+        self.perturb = bool(perturb)
+        self.rollback_on_spike = int(rollback_on_spike)
+        # run state
+        self.rollbacks_used = 0
+        self.lr_scale = 1.0
+        self.gave_up = False
+        self.events: List[dict] = []
+        self._spikes_since_rollback = 0
+        self._m_rollbacks = observability.counter(
+            _pipeline.ROLLBACKS_METRIC,
+            "anomaly-triggered training rollbacks by verdict reason",
+            ("reason",),
+        )
+        self._m_give_up = observability.gauge(
+            _pipeline.ROLLBACK_GIVE_UP_METRIC,
+            "1 once the recovery policy gave up (rollback budget spent "
+            "or no valid snapshot) — znicz-doctor's exit-1 gate",
+        )
+
+    # -- decision ----------------------------------------------------------
+    def should_rollback(self, anomalies: List[dict]) -> Optional[str]:
+        """Map a batch of detector verdicts to a rollback reason (the
+        verdict type that triggered), or None to keep training."""
+        for a in anomalies:
+            if a.get("type") in NON_FINITE_TYPES:
+                return a["type"]
+        if self.rollback_on_spike:
+            spikes = sum(
+                1 for a in anomalies
+                if a.get("type") == _anomaly.LOSS_SPIKE
+            )
+            if spikes:
+                self._spikes_since_rollback += spikes
+                if self._spikes_since_rollback >= self.rollback_on_spike:
+                    return _anomaly.LOSS_SPIKE
+        return None
+
+    # -- bookkeeping (the workflow calls these around the restore) ---------
+    def budget_left(self) -> bool:
+        return self.rollbacks_used < self.max_rollbacks
+
+    def note_rollback(
+        self, reason: str, *, step: int, source: str
+    ) -> dict:
+        """Record one executed rollback: budget, counter, lr backoff."""
+        self.rollbacks_used += 1
+        self.lr_scale *= self.lr_backoff
+        self._spikes_since_rollback = 0
+        self._m_rollbacks.labels(reason=reason).inc()
+        event = {
+            "kind": "rollback",
+            "reason": reason,
+            "step": int(step),
+            "source": source,
+            "rollbacks_used": self.rollbacks_used,
+            "lr_scale": self.lr_scale,
+            "unix": time.time(),  # timestamp, not a duration
+        }
+        self.events.append(event)
+        return event
+
+    def note_give_up(self, reason: str, *, step: int, why: str) -> None:
+        self.gave_up = True
+        self._m_give_up.set(1.0)
+        self.events.append(
+            {
+                "kind": "give_up",
+                "reason": reason,
+                "step": int(step),
+                "why": why,
+                "unix": time.time(),  # timestamp, not a duration
+            }
+        )
+
+    def report(self) -> Dict[str, object]:
+        """JSON-able readout for ``status.json["recovery"]``."""
+        return {
+            "rollbacks_used": self.rollbacks_used,
+            "max_rollbacks": self.max_rollbacks,
+            "lr_scale": self.lr_scale,
+            "gave_up": self.gave_up,
+            "events": [dict(e) for e in self.events],
+        }

@@ -7,23 +7,57 @@ SURVEY.md §7: instead of pickling the live workflow object graph, a snapshot
 is (a) the pure pytree train state (params/velocity/step/rng-key) converted
 to numpy, and (b) an explicit host-state dict (decision, loader, prng
 registry) — so checkpoints survive code refactors and process restarts.
+
+Crash safety (docs/TRAINING.md "Self-healing training"): every snapshot
+is written atomically (tmp + ``os.replace``) with a sha256 **integrity
+sidecar** (``<file>.sha256``) committed only after the data file, so a
+crash at any byte leaves either the previous snapshot intact or a
+digest-mismatched file the loaders treat as corrupt.  ``load_snapshot``
+raises a typed :class:`SnapshotCorruptError` on truncation / digest
+mismatch / undecodable payload instead of a bare ``pickle``/``EOFError``,
+and :func:`find_latest_valid` walks a directory newest→oldest past
+corrupt files so a resume always lands on a verifiable checkpoint.
 """
 
 from __future__ import annotations
 
 import gzip
+import hashlib
+import logging
 import os
 import pickle
 import re
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+import zlib
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from znicz_tpu import observability
+from znicz_tpu.observability import pipeline as _pipeline
+from znicz_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
 
 FORMAT_VERSION = 1
+
+SIDECAR_SUFFIX = ".sha256"
+
+# snapshot files a Snapshotter (any prefix) may have written
+_SNAPSHOT_RE = re.compile(r".+\.pickle(\.gz)?$")
+
+
+class SnapshotCorruptError(RuntimeError):
+    """The snapshot file exists but cannot be trusted: truncated,
+    digest-mismatched against its sidecar, or undecodable."""
+
+
+class SnapshotWriteError(RuntimeError):
+    """Writing a snapshot failed (disk full, permissions, injected
+    fault).  The previous snapshot is untouched — ``maybe_save``
+    swallows this (counted + logged) so a flaky disk costs a
+    checkpoint, never the run."""
 
 
 class _KeyLeaf(NamedTuple):
@@ -71,18 +105,184 @@ def _from_host(tree):
     )
 
 
+def _sidecar_path(path: str) -> str:
+    return path + SIDECAR_SUFFIX
+
+
+def _digest_file(path: str) -> str:
+    """Chunked sha256 of a file — snapshots can be multi-GB; neither
+    the save nor the load/verify path may hold one in RAM to hash it."""
+    hasher = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            hasher.update(chunk)
+    return hasher.hexdigest()
+
+
+def _sidecar_fields(path: str) -> Optional[List[str]]:
+    """The sidecar's whitespace fields (digest, basename, vN...), or
+    None when no sidecar exists (a pre-sidecar snapshot)."""
+    sidecar = _sidecar_path(path)
+    if not os.path.exists(sidecar):
+        return None
+    with open(sidecar) as f:
+        return f.read().strip().split()
+
+
+def _check_sidecar(path: str) -> bool:
+    """Digest-check ``path`` against its sidecar (chunked read).
+    Returns True when a sidecar existed and matched, False when there
+    is none; raises :class:`SnapshotCorruptError` on a mismatch."""
+    fields = _sidecar_fields(path)
+    if fields is None:
+        return False
+    want = fields[0] if fields else ""
+    got = _digest_file(path)
+    if want != got:
+        raise SnapshotCorruptError(
+            f"snapshot {path} fails its sha256 sidecar check "
+            f"(want {want[:12]}..., got {got[:12]}...) — truncated "
+            "or partially overwritten; resume from an older snapshot"
+        )
+    return True
+
+
+def _decode_file(path: str) -> dict:
+    """Streamed file -> payload dict (no full-file resident copy);
+    every decode failure mode becomes the one typed
+    :class:`SnapshotCorruptError`."""
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rb") as f:
+            payload = pickle.load(f)
+    except (
+        pickle.UnpicklingError,
+        EOFError,
+        gzip.BadGzipFile,
+        zlib.error,
+        AttributeError,  # missing class on unpickle
+        MemoryError,
+        IndexError,
+        KeyError,
+        UnicodeDecodeError,
+    ) as exc:
+        raise SnapshotCorruptError(
+            f"snapshot {path} is unreadable ({type(exc).__name__}: {exc})"
+        ) from exc
+    if not isinstance(payload, dict):
+        raise SnapshotCorruptError(
+            f"snapshot {path} decodes to {type(payload).__name__}, "
+            "not a snapshot payload"
+        )
+    return payload
+
+
 def load_snapshot(path: str) -> Tuple[Any, Dict[str, Any]]:
     """Read a snapshot file -> (train_state, host_state).  Standalone so a
-    resume never requires a snapshot-writing policy to be configured."""
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rb") as f:
-        payload = pickle.load(f)
+    resume never requires a snapshot-writing policy to be configured.
+
+    Raises :class:`SnapshotCorruptError` on truncation, sidecar digest
+    mismatch or an undecodable payload (never a bare pickle error), and
+    ``ValueError`` on a format-version mismatch (a valid file this code
+    doesn't speak — not corruption)."""
+    try:
+        faults.fire("snapshot.load")
+    except faults.FaultInjected as exc:
+        # the chaos point simulates an unreadable checkpoint: typed,
+        # so find_latest_valid / rollback fall through to older ones
+        raise SnapshotCorruptError(
+            f"snapshot {path} unreadable (injected)"
+        ) from exc
+    _check_sidecar(path)
+    payload = _decode_file(path)
     if payload.get("format_version") != FORMAT_VERSION:
         raise ValueError(
             f"snapshot {path} has format {payload.get('format_version')}, "
             f"expected {FORMAT_VERSION}"
         )
-    return _from_host(payload["train_state"]), payload["host_state"]
+    try:
+        train_state, host_state = payload["train_state"], payload["host_state"]
+    except KeyError as exc:
+        raise SnapshotCorruptError(
+            f"snapshot {path} payload is missing {exc}"
+        ) from exc
+    return _from_host(train_state), host_state
+
+
+def verify_snapshot(path: str) -> None:
+    """Cheap usability check: sidecar digest (and the format version it
+    records) when present, else a full decode attempt.  Raises
+    :class:`SnapshotCorruptError` on untrustworthy bytes, ``ValueError``
+    on a version-skewed (valid but unloadable) snapshot, OSError on an
+    unreadable file; returns None when the snapshot is resumable."""
+    if _check_sidecar(path):
+        # version skew recorded in the sidecar: the file is intact but
+        # load_snapshot would reject it — find_latest_valid must fall
+        # through to an older COMPATIBLE snapshot instead of handing
+        # the launcher a checkpoint that crash-loops the supervisor
+        for field in (_sidecar_fields(path) or [])[2:]:
+            if field.startswith("v") and field[1:].isdigit():
+                if int(field[1:]) != FORMAT_VERSION:
+                    raise ValueError(
+                        f"snapshot {path} has format {field[1:]}, "
+                        f"expected {FORMAT_VERSION}"
+                    )
+        return
+    # pre-sidecar snapshot: the only way to verify is to decode it
+    payload = _decode_file(path)
+    if payload.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"snapshot {path} has format "
+            f"{payload.get('format_version')}, expected {FORMAT_VERSION}"
+        )
+
+
+def is_valid_snapshot(path: str) -> bool:
+    try:
+        verify_snapshot(path)
+        return True
+    except Exception:
+        logger.warning("snapshot %s failed verification", path, exc_info=True)
+        return False
+
+
+def find_latest_valid(
+    directory: str,
+    prefix: Optional[str] = None,
+    *,
+    exclude=(),
+) -> Optional[str]:
+    """Newest verifiable snapshot in ``directory`` (by mtime, newest
+    first), or None.  Corrupt / truncated / unreadable files are logged
+    and skipped — resume always lands on a checkpoint that passes
+    :func:`verify_snapshot`, or starts fresh.  ``exclude``: paths the
+    caller already tried and found unloadable (verification is a digest
+    check, so a digest-valid file can still fail to unpickle — e.g. a
+    since-renamed class; the launcher quarantines it and asks again)."""
+    try:
+        names = os.listdir(directory)
+    # an absent/unreadable directory has no snapshots to offer
+    except OSError:  # znicz-check: disable=ZNC008
+        return None
+    excluded = set(exclude)
+    candidates: List[Tuple[float, str]] = []
+    for name in names:
+        if not _SNAPSHOT_RE.fullmatch(name):
+            continue
+        if prefix is not None and not name.startswith(prefix + "_"):
+            continue
+        path = os.path.join(directory, name)
+        if path in excluded:
+            continue
+        try:
+            candidates.append((os.path.getmtime(path), path))
+        # deleted between listdir and stat: not a candidate
+        except OSError:  # znicz-check: disable=ZNC008
+            continue
+    for _, path in sorted(candidates, reverse=True):
+        if is_valid_snapshot(path):
+            return path
+    return None
 
 
 class Snapshotter:
@@ -90,7 +290,9 @@ class Snapshotter:
 
     ``interval``: also snapshot every N epochs regardless of improvement
     (0 = only on improvement).  ``keep``: retain at most N non-best snapshots
-    (best is always kept).
+    (best is always kept) — pruning counts VERIFIED snapshots, so the only
+    remaining valid checkpoint is never deleted even when newer files are
+    corrupt.
     """
 
     def __init__(
@@ -117,6 +319,14 @@ class Snapshotter:
         # processes — they still participate in save()'s (possibly
         # collective) device->host readback, but never touch the filesystem
         self.writer = True
+        self._m_failures = observability.counter(
+            _pipeline.SNAPSHOT_FAILURES_METRIC,
+            "snapshot writes that failed (previous snapshot left intact)",
+        )
+        # paths THIS process wrote successfully: prune() trusts them
+        # without re-reading multi-GB files to re-hash a digest this
+        # process computed moments earlier
+        self._verified: set = set()
         os.makedirs(directory, exist_ok=True)
         # Recover periodic snapshots from a previous process so "keep at
         # most N" holds across restarts, oldest (lowest epoch tag) first.
@@ -164,9 +374,58 @@ class Snapshotter:
             opener = gzip.open if self.compress else open
             tmp = path + ".tmp"
             with observability.span("snapshot/write", path=path):
-                with opener(tmp, "wb") as f:
-                    pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-                os.replace(tmp, path)
+                replaced = False
+                try:
+                    faults.fire("snapshot.write")
+                    with opener(tmp, "wb") as f:
+                        pickle.dump(
+                            payload, f, protocol=pickle.HIGHEST_PROTOCOL
+                        )
+                    # chunked hash: never hold a multi-GB serialized
+                    # snapshot in host RAM beside the payload
+                    digest = _digest_file(tmp)
+                    # data file first, sidecar second: a crash in the
+                    # window leaves a new file with the OLD sidecar —
+                    # a digest MISMATCH the loaders skip, never a
+                    # silently-trusted torn snapshot
+                    os.replace(tmp, path)
+                    replaced = True
+                    side_tmp = _sidecar_path(path) + ".tmp"
+                    with open(side_tmp, "w") as f:
+                        f.write(
+                            f"{digest}  {os.path.basename(path)}  "
+                            f"v{FORMAT_VERSION}\n"
+                        )
+                    os.replace(side_tmp, _sidecar_path(path))
+                except (OSError, faults.FaultInjected) as exc:
+                    for leftover in (tmp, _sidecar_path(path) + ".tmp"):
+                        try:
+                            os.remove(leftover)
+                        # best-effort cleanup on the failure path
+                        except OSError:  # znicz-check: disable=ZNC008
+                            pass
+                    if replaced:
+                        # the NEW data file already landed (the replace
+                        # succeeded; only the sidecar write failed): a
+                        # stale sidecar from the previous write would
+                        # condemn the good new file forever — drop it
+                        # so the file verifies by decode, and report
+                        # SUCCESS (the checkpoint exists; callers must
+                        # track it for retention/resume)
+                        try:
+                            os.remove(_sidecar_path(path))
+                        except OSError:  # znicz-check: disable=ZNC008
+                            pass
+                        logger.warning(
+                            "snapshot %s written but its sidecar "
+                            "failed (%s); it will verify by decode",
+                            path, exc,
+                        )
+                        return path
+                    raise SnapshotWriteError(
+                        f"snapshot write to {path} failed: {exc}"
+                    ) from exc
+            self._verified.add(path)
         return path
 
     def load(self, path: str) -> Tuple[Any, Dict[str, Any]]:
@@ -182,17 +441,60 @@ class Snapshotter:
     ) -> Optional[str]:
         """Snapshot policy: on validation improvement -> overwrite 'best'
         (unless ``save_best=False``); every ``interval`` epochs -> tagged
-        periodic snapshot."""
+        periodic snapshot.  A :class:`SnapshotWriteError` is swallowed
+        (counted + logged): a failed checkpoint must not kill the run —
+        the previous snapshot is intact and the next interval retries."""
         path = None
         if improved and self.save_best:
-            path = self.save(train_state, host_state, tag="best")
+            try:
+                path = self.save(train_state, host_state, tag="best")
+            except SnapshotWriteError:
+                self._m_failures.inc()
+                logger.exception("best-snapshot write failed; continuing")
+                path = None
         if self.interval and (epoch + 1) % self.interval == 0:
-            path = self.save(train_state, host_state, tag=f"epoch{epoch}")
+            try:
+                path = self.save(train_state, host_state, tag=f"epoch{epoch}")
+            except SnapshotWriteError:
+                self._m_failures.inc()
+                logger.exception(
+                    "epoch%d snapshot write failed; continuing", epoch
+                )
+                return None
             self._kept.append(path)
-            while len(self._kept) > self.keep:
-                old = self._kept.pop(0)
-                # only the writer touches the filesystem (multi-host
-                # processes share bookkeeping but must not race on removes)
-                if self.writer and os.path.exists(old):
-                    os.remove(old)
+            self.prune()
         return path
+
+    def prune(self) -> None:
+        """Apply the ``keep`` retention bound to periodic snapshots.
+
+        Prunes by the VERIFIED set, not filename count: an old snapshot
+        is only deleted while at least one newer kept snapshot passes
+        :func:`verify_snapshot` — when every newer file is corrupt, the
+        old valid one is retained past the bound (the bound is a disk
+        budget; an unresumable run is a lost run)."""
+        while len(self._kept) > self.keep:
+            old = self._kept[0]
+            if self.writer and os.path.exists(old):
+                # newest first: the just-written (in-_verified) path
+                # short-circuits the sweep, so a restart-recovered
+                # ledger never re-hashes old multi-GB files per save
+                newer_valid = any(
+                    os.path.exists(p)
+                    and (p in self._verified or is_valid_snapshot(p))
+                    for p in reversed(self._kept[1:])
+                )
+                if not newer_valid:
+                    logger.warning(
+                        "retaining %s past keep=%d: no newer snapshot "
+                        "verifies", old, self.keep,
+                    )
+                    return
+            self._kept.pop(0)
+            self._verified.discard(old)
+            # only the writer touches the filesystem (multi-host
+            # processes share bookkeeping but must not race on removes)
+            if self.writer:
+                for victim in (old, _sidecar_path(old)):
+                    if os.path.exists(victim):
+                        os.remove(victim)

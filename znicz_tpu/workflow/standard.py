@@ -54,6 +54,7 @@ class StandardWorkflow(Workflow):
         epoch_dispatch: str = "auto",
         epoch_sync: str = "sync",
         anomaly=True,
+        recovery=None,
         rand_name: str = "default",
         name: str = "StandardWorkflow",
     ):
@@ -99,6 +100,7 @@ class StandardWorkflow(Workflow):
             epoch_dispatch=epoch_dispatch,
             epoch_sync=epoch_sync,
             anomaly=anomaly,
+            recovery=recovery,
             name=name,
         )
 

@@ -453,6 +453,7 @@ class TransformerLMWorkflow(Workflow):
         parallel=None,
         prefetch_batches: int = 2,
         epoch_sync: str = "sync",
+        recovery=None,
         rand_name: str = "default",
         name: str = "TransformerLMWorkflow",
     ):
@@ -471,6 +472,7 @@ class TransformerLMWorkflow(Workflow):
             parallel=parallel,
             prefetch_batches=prefetch_batches,
             epoch_sync=epoch_sync,
+            recovery=recovery,
             name=name,
         )
         self.vocab = vocab

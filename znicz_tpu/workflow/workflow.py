@@ -30,9 +30,35 @@ from znicz_tpu.nn.train_state import TrainState
 from znicz_tpu.observability import PhaseTimer
 from znicz_tpu.observability import pipeline as pipeline_obs
 from znicz_tpu.observability.anomaly import StepAnomalyDetector
+from znicz_tpu.utils import faults
 from znicz_tpu.utils.profiling import Stopwatch
 from znicz_tpu.workflow.model import Model
-from znicz_tpu.workflow.snapshotter import Snapshotter
+from znicz_tpu.workflow.recovery import (
+    RecoveryPolicy,
+    RollbackExhaustedError,
+    TrainingPreempted,
+)
+from znicz_tpu.workflow.snapshotter import (
+    SnapshotCorruptError,
+    Snapshotter,
+    SnapshotWriteError,
+    find_latest_valid,
+    load_snapshot,
+)
+
+
+class _RollbackSignal(Exception):
+    """Internal control flow: an anomaly verdict asked for a rollback.
+    Raised at the feed points, caught by :meth:`Workflow.run_epoch`."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class _PreemptSignal(Exception):
+    """Internal control flow: a requested stop reached a step boundary
+    mid-epoch (the in-flight dispatch has drained)."""
 
 
 def _is_additive(name: str) -> bool:
@@ -103,6 +129,7 @@ class Workflow(Logger):
         epoch_dispatch: str = "auto",  # "auto" | "scan" | "step"
         epoch_sync: str = "sync",  # "sync" | "deferred"
         anomaly=True,  # True = default detector; False/None = off
+        recovery: Optional[RecoveryPolicy] = None,
         name: str = "workflow",
     ):
         self.loader = loader
@@ -163,6 +190,23 @@ class Workflow(Logger):
             )
         else:
             self.anomaly = anomaly or None
+        # self-healing (docs/TRAINING.md): the recovery policy consumes
+        # the detector's verdicts, so it needs the detector on
+        if recovery is not None and self.anomaly is None:
+            raise ValueError(
+                "recovery=... consumes the step anomaly detector's "
+                "verdicts; it cannot combine with anomaly=False"
+            )
+        self.recovery = recovery
+        # graceful-stop plumbing: request_stop() (usually from a
+        # SIGTERM/SIGINT handler) flips the flag, the loops act on it
+        # at the next step boundary
+        self._preempt_requested = False
+        # when True (enable_emergency_snapshots), each sync-mode epoch
+        # retains its START state so a mid-epoch stop/rollback can land
+        # on a consistent (state, loader, prng, decision) quadruple
+        self._emergency_capture = False
+        self._epoch_start = None
         # host->device transfer probe for the streaming batch path; the
         # step-wall histogram it pairs with is observed in the stepwise
         # consumer loop
@@ -409,10 +453,8 @@ class Workflow(Logger):
         if seed is not None:
             prng.seed_all(seed)
         if snapshot:
-            from znicz_tpu.workflow.snapshotter import load_snapshot
-
             state, host = load_snapshot(snapshot)
-            self.state = TrainState(*state)
+            self.state = TrainState(*state)  # host leaves; placed below
             if "decision" in host:
                 self.decision.load_state_dict(host["decision"])
             if "loader" in host:
@@ -441,6 +483,15 @@ class Workflow(Logger):
                     param_rules=rules,
                 )
             self.state = self.parallel.shard_state(self.state)
+        elif snapshot:
+            # device-place the restored host leaves: a resumed step fed
+            # numpy arrays would recompile (placement rides the
+            # executable-cache key).  Done HERE, after the (absent)
+            # placement-policy branch, so a sharded resume never
+            # round-trips the full state through the default device.
+            self.state = jax.tree_util.tree_map(
+                jax.device_put, self.state
+            )
         # multi-host: every process runs this same loop; the loader serves
         # per-process sample shards, snapshot/services write on exactly one
         # process (the reference's master-does-bookkeeping role, SURVEY 3.4)
@@ -560,11 +611,19 @@ class Workflow(Logger):
                 masks = self._put_stacked(np.stack([mb.mask for mb in mbs]))
             with self.timer.phase(f"dispatch/{split}"):
                 if split == TRAIN:
+                    rec_scale = (
+                        self.recovery.lr_scale
+                        if self.recovery is not None
+                        else 1.0
+                    )
                     lrs_host = np.asarray(
                         [
-                            self.lr_policy(1.0, self._host_step + i)
-                            if self.lr_policy
-                            else 1.0
+                            (
+                                self.lr_policy(1.0, self._host_step + i)
+                                if self.lr_policy
+                                else 1.0
+                            )
+                            * rec_scale
                             for i in range(len(mbs))
                         ],
                         np.float32,
@@ -599,9 +658,30 @@ class Workflow(Logger):
         one epoch (None on the very first call); stop decisions stay
         EXACT — when the Decision could possibly stop on the pending
         epoch, it is flushed synchronously before anything new dispatches.
+
+        Self-healing control flow (docs/TRAINING.md): a rollback-worthy
+        anomaly verdict aborts the epoch, restores the last good
+        snapshot and returns None (the ``run`` loop re-dispatches); a
+        requested stop drains the in-flight step, writes an emergency
+        snapshot and raises :class:`TrainingPreempted`.
         """
         if self.state is None:
             self.initialize()
+        # chaos point: a hard process crash at an epoch boundary (arm
+        # with after=k to crash entering epoch k — the supervised
+        # auto-resume fixture)
+        faults.fire("train.crash")
+        if self._preempt_requested:
+            self._graceful_exit(mid_epoch=False)
+        try:
+            return self._run_epoch_inner()
+        except _PreemptSignal:
+            self._graceful_exit(mid_epoch=True)
+        except _RollbackSignal as sig:
+            self._execute_rollback(sig.reason)
+            return None
+
+    def _run_epoch_inner(self) -> Optional[Dict[str, Any]]:
         deferred = self.epoch_sync == "deferred"
         flushed = None
         # pending must resolve synchronously (BEFORE the next dispatch)
@@ -624,6 +704,15 @@ class Workflow(Logger):
             flushed = self._finish_epoch(accs)
             if flushed["stop"]:
                 return flushed  # nothing new dispatched
+        if (
+            self.recovery is not None or self._emergency_capture
+        ) and not deferred:
+            # epoch-START retention: the rollback fallback when no
+            # snapshot exists yet, and the emergency snapshot's source
+            # on a mid-epoch stop — the one point where (state, loader,
+            # prng, decision) are mutually consistent.  Fresh buffers
+            # (jnp.copy): the train step donates self.state's.
+            self._epoch_start = self._retain_epoch_start()
         accs = (
             self._run_epoch_scanned()
             if self._use_epoch_scan()
@@ -670,7 +759,11 @@ class Workflow(Logger):
         # nothing was dispatched after the pending epoch, so self.state is
         # exactly that epoch's — the retained copy is redundant
         self._retained = None
-        return self._finish_epoch(accs)
+        try:
+            return self._finish_epoch(accs)
+        except _RollbackSignal as sig:
+            self._execute_rollback(sig.reason)
+            return None
 
     def _retain_state(self):
         """Copy of the CURRENT epoch's snapshot inputs, held until its
@@ -686,6 +779,183 @@ class Workflow(Logger):
             "loader": self.loader.state_dict(),
             "prng": prng.state_dict(),
         }
+
+    # -- self-healing (docs/TRAINING.md) -------------------------------------
+    def request_stop(self) -> None:
+        """Ask the run to stop gracefully at the next step boundary:
+        the in-flight dispatch drains, an emergency snapshot is written
+        and :class:`TrainingPreempted` raises out of ``run``/``run_epoch``
+        (the launcher maps it to exit code ``EXIT_PREEMPTED``).  Safe to
+        call from a signal handler (one bool store)."""
+        self._preempt_requested = True
+
+    def enable_emergency_snapshots(self) -> None:
+        """Retain each sync-mode epoch's START state (one extra copy of
+        the train state held per epoch) so a mid-epoch stop writes a
+        CONSISTENT emergency snapshot — resume replays the aborted
+        epoch exactly.  The launcher enables this whenever it installs
+        signal handlers and a snapshotter exists; without it a
+        mid-epoch stop snapshots the current (mid-epoch) params, which
+        resumes correctly but not byte-exactly."""
+        self._emergency_capture = True
+
+    def _retain_epoch_start(self):
+        """Fresh copies of the epoch-START restore quadruple: train
+        state + decision/loader/prng host state (the same shape a
+        snapshot file holds)."""
+        state = jax.tree_util.tree_map(jnp.copy, self.state)
+        return state, self.host_state()
+
+    def _restore_from(self, state, host: Dict[str, Any]) -> None:
+        """The exact-resume contract, shared by ``initialize(snapshot=)``
+        rollback and chaos tests: restore train state (re-sharded under
+        the placement policy) and the decision/loader/prng host state.
+        Re-feeds the ALREADY-COMPILED step — shapes/dtypes/structure are
+        unchanged, so restoring compiles nothing new (pinned in tier-1)."""
+        st = state if isinstance(state, TrainState) else TrainState(*state)
+        if self.parallel is not None:
+            st = self.parallel.shard_state(st)
+        else:
+            # device-place host (numpy) leaves NOW: a numpy argument
+            # misses the already-compiled step's executable-cache entry
+            # (placement rides the pjit cache key), which would make the
+            # "rollback compiles nothing" pin false
+            st = jax.tree_util.tree_map(jax.device_put, st)
+        self.state = st
+        host = host or {}
+        if "decision" in host:
+            self.decision.load_state_dict(host["decision"])
+        if "loader" in host:
+            self.loader.load_state_dict(host["loader"])
+        if "prng" in host:
+            prng.load_state_dict(host["prng"])
+        self._host_step = int(self.state.step)
+
+    def _execute_rollback(self, reason: str) -> None:
+        """Roll the run back to its last good restore point.
+
+        Source preference: the in-memory epoch-START buffer when one
+        was captured (it is always at least as fresh as any snapshot
+        file, and detection lands within its epoch, so the buffer
+        predates the fault — preferring an older snapshot would
+        silently re-run up to ``interval - 1`` healthy epochs), else
+        the newest VALID snapshot file.  Bounded by the policy's
+        rollback budget — past it (or with no restore point) the typed
+        :class:`RollbackExhaustedError` raises, with the give-up gauge
+        set for ``znicz-doctor``."""
+        pol = self.recovery
+        step = self._host_step
+        # poisoned in-flight bookkeeping dies with the aborted epoch
+        self._pending_accs = None
+        self._retained = None
+        self._pending_watch = []
+        if not pol.budget_left():
+            pol.note_give_up(
+                reason, step=step, why="rollback budget spent"
+            )
+            raise RollbackExhaustedError(
+                f"anomaly {reason!r} at step {step}: rollback budget "
+                f"({pol.max_rollbacks}) spent — giving up"
+            )
+        state = host = None
+        source = None
+        if self._epoch_start is not None:
+            state, host = self._epoch_start
+            source = "epoch-start buffer"
+        if source is None and self.snapshotter is not None:
+            path = find_latest_valid(
+                self.snapshotter.directory, prefix=self.snapshotter.prefix
+            )
+            if path is not None:
+                try:
+                    state, host = load_snapshot(path)
+                    source = path
+                except (SnapshotCorruptError, ValueError):
+                    # verified then unreadable (raced delete / injected
+                    # load fault): nothing left to restore from
+                    self.logger.exception(
+                        "rollback snapshot %s unreadable", path
+                    )
+        if source is None:
+            pol.note_give_up(
+                reason,
+                step=step,
+                why="no valid snapshot or retained epoch-start state",
+            )
+            raise RollbackExhaustedError(
+                f"anomaly {reason!r} at step {step}: no valid snapshot "
+                "or retained epoch-start state to roll back to"
+            )
+        self._restore_from(state, host)
+        if pol.perturb:
+            # advance the shuffle stream so the replayed window draws a
+            # different permutation — a data-order-dependent blowup
+            # doesn't deterministically recur (costs golden-exactness;
+            # perturb=False keeps the replay byte-identical)
+            gen = prng.get(self.loader.rand_name)
+            gen.permutation(
+                max(self.loader.class_lengths.get(TRAIN, 1), 1)
+            )
+        pol.note_rollback(reason, step=step, source=str(source))
+        self.info(
+            "rolled back to %s after %s at step %d "
+            "(rollback %d/%d, lr_scale %.4g)",
+            source, reason, step,
+            pol.rollbacks_used, pol.max_rollbacks, pol.lr_scale,
+        )
+
+    def _graceful_exit(self, *, mid_epoch: bool) -> None:
+        """Finish a requested stop: write the emergency snapshot (the
+        epoch-START buffer when stopping mid-epoch so the resume is
+        exact; the current state between epochs) and raise the typed
+        :class:`TrainingPreempted`."""
+        path = None
+        if self.snapshotter is not None:
+            if mid_epoch and self._epoch_start is not None:
+                state, host = self._epoch_start
+            else:
+                # deferred mode: flush the pending epoch first so the
+                # snapshot's decision state is consistent with the
+                # params it rides with.  Mid-epoch, self.state is
+                # ALREADY the next epoch's partial state, so the flush
+                # must write from the retained pending-epoch buffer
+                # (sync_epoch would drop it and save torn params).
+                retained, self._retained = self._retained, None
+                if self._pending_accs is not None:
+                    accs, self._pending_accs = self._pending_accs, None
+                    try:
+                        self._finish_epoch(accs, retained=retained)
+                    # stopping anyway: a rollback is moot mid-shutdown
+                    except _RollbackSignal:  # znicz-check: disable=ZNC008
+                        pass
+                    except Exception:
+                        self.logger.exception(
+                            "pending-epoch flush failed during "
+                            "graceful stop"
+                        )
+                if mid_epoch and retained is not None:
+                    # deferred + mid-epoch: the retained buffer (the
+                    # flushed epoch's end state) plus the now-current
+                    # decision IS the next epoch's consistent START
+                    # quadruple — resume replays the aborted epoch
+                    r_state, r_host = retained
+                    state, host = r_state, {
+                        "decision": self.decision.state_dict(),
+                        "loader": r_host["loader"],
+                        "prng": r_host["prng"],
+                    }
+                else:
+                    state, host = self.state, self.host_state()
+            try:
+                path = self.snapshotter.save(state, host, tag="emergency")
+                self.info("graceful stop: emergency snapshot %s", path)
+            except SnapshotWriteError:
+                self.logger.exception("emergency snapshot write failed")
+        raise TrainingPreempted(
+            "training stopped on request (SIGTERM/SIGINT); resume from "
+            "the emergency snapshot (launcher: --resume auto)",
+            snapshot_path=path,
+        )
 
     def _run_epoch_stepwise(self) -> Dict[str, jax.Array]:
         accs: Dict[str, jax.Array] = {}  # per-split on-device accumulators
@@ -738,6 +1008,10 @@ class Workflow(Logger):
         watch_q: deque = deque()
         t_prev = time.perf_counter()
         for split, x, y, mask in epoch_iter:
+            if self._preempt_requested:
+                # the previous dispatch is the in-flight step; it
+                # drains on its own — stop BEFORE dispatching another
+                raise _PreemptSignal()
             with self.timer.phase(f"dispatch/{split}"):
                 acc = accs.get(split)
                 if acc is None:
@@ -748,6 +1022,9 @@ class Workflow(Logger):
                         if self.lr_policy
                         else 1.0
                     )
+                    if self.recovery is not None:
+                        # rollback LR backoff composes with the policy
+                        lr_scale *= self.recovery.lr_scale
                     self.state, acc, watch = self._train_step(
                         self.state, x, y, mask, lr_scale, acc, self._ctx
                     )
@@ -771,38 +1048,55 @@ class Workflow(Logger):
                     (self._host_step - 1, watch, step_wall)
                 )
                 if len(watch_q) > 2:  # ~2 steps of transfer lag
-                    self._feed_watch(*watch_q.popleft())
+                    self._check_recovery(
+                        self._feed_watch(*watch_q.popleft())
+                    )
         while watch_q:
-            self._feed_watch(*watch_q.popleft())
+            self._check_recovery(self._feed_watch(*watch_q.popleft()))
         return accs
 
-    def _feed_watch(self, step, watch, step_seconds=None) -> None:
-        """Hand one lagged watch vector to the anomaly detector.  The
-        read is of an already-transferred tiny array (the async copy
-        started at dispatch); the detector must never kill training."""
+    def _feed_watch(self, step, watch, step_seconds=None) -> list:
+        """Hand one lagged watch vector to the anomaly detector; returns
+        the verdicts it raised (the recovery policy's input).  The read
+        is of an already-transferred tiny array (the async copy started
+        at dispatch); the detector must never kill training — only a
+        returned verdict may (via the recovery policy's typed path)."""
         if self.anomaly is None:
-            return
+            return []
         try:
             vals = np.asarray(
                 jax.device_get(watch),  # znicz-check: disable=ZNC007
                 np.float32,
             )
-            self.anomaly.observe_step(
+            loss = float(vals[0])
+            grad_norm = float(vals[1])
+        except Exception:
+            self.logger.exception("anomaly watch feed failed")
+            return []
+        if faults.fire("train.step_nan"):
+            # behavioral chaos point: the detector (and the recovery
+            # policy behind it) sees a non-finite loss without actually
+            # poisoning device state — the rollback path's CI fixture
+            loss = float("nan")
+        try:
+            return self.anomaly.observe_step(
                 int(step),
-                loss=float(vals[0]),
-                grad_norm=float(vals[1]),
+                loss=loss,
+                grad_norm=grad_norm,
                 step_seconds=step_seconds,
             )
         except Exception:
             self.logger.exception("anomaly watch feed failed")
+            return []
 
-    def _drain_watches(self) -> None:
+    def _drain_watches(self) -> list:
         """Feed the scanned epochs' pending watch stacks ([n_steps, 2])
         to the detector — called at the epoch's metric sync, where a
-        device fetch already happens."""
+        device fetch already happens.  Returns the raised verdicts."""
         pending, self._pending_watch = self._pending_watch, []
         if self.anomaly is None:
-            return
+            return []
+        raised: list = []
         for start_step, watches in pending:
             try:
                 rows = np.asarray(
@@ -813,18 +1107,41 @@ class Workflow(Logger):
                 self.logger.exception("anomaly watch drain failed")
                 continue
             for i, row in enumerate(rows):
-                self.anomaly.observe_step(
-                    start_step + i,
-                    loss=float(row[0]),
-                    grad_norm=float(row[1]),
+                raised.extend(
+                    self._feed_scan_row(start_step + i, row)
                 )
+        return raised
+
+    def _feed_scan_row(self, step: int, row) -> list:
+        loss = float(row[0])
+        if faults.fire("train.step_nan"):
+            loss = float("nan")
+        try:
+            return self.anomaly.observe_step(
+                step, loss=loss, grad_norm=float(row[1])
+            )
+        except Exception:
+            self.logger.exception("anomaly watch drain failed")
+            return []
+
+    def _check_recovery(self, anomalies: list) -> None:
+        """Route fresh verdicts through the recovery policy; a
+        rollback-worthy one aborts the epoch via :class:`_RollbackSignal`
+        (caught in :meth:`run_epoch`)."""
+        if not anomalies or self.recovery is None:
+            return
+        reason = self.recovery.should_rollback(anomalies)
+        if reason is not None:
+            raise _RollbackSignal(reason)
 
     def _finish_epoch(
         self, accs: Dict[str, jax.Array], retained=None
     ) -> Dict[str, Any]:
         # scanned-epoch watch vectors resolve here, where a device
-        # fetch happens anyway (their async copies started at dispatch)
-        self._drain_watches()
+        # fetch happens anyway (their async copies started at dispatch);
+        # a rollback-worthy verdict aborts BEFORE the poisoned metrics
+        # reach the decision
+        self._check_recovery(self._drain_watches())
         with self.timer.phase("metrics_sync"):
             # one tiny existing-buffer fetch per split (no per-batch
             # syncs) — the per-EPOCH fetch this design exists to bound
