@@ -2200,3 +2200,750 @@ class TestEngine:
         )
         fs = engine.analyze_paths([str(mod)], root=str(tmp_path))
         assert fs[0].path == "m.py"
+
+
+# -- project rules: ZNC014/ZNC015/ZNC016 ---------------------------------
+
+
+def run_project(sources, rule_id):
+    """Run ONE project rule over an in-memory multi-file project
+    (``{rel_path: source}``), suppression applied — the harness for
+    the dataflow/lock-order/blocking rules, which reason over the
+    whole index instead of one module."""
+    from znicz_tpu.analysis.project import (
+        ProjectIndex,
+        project_rule_findings,
+    )
+
+    idx = ProjectIndex("/proj")
+    for rel, src in sources.items():
+        idx.add_module(textwrap.dedent(src), rel)
+    idx.link()
+    rule = RULES[rule_id]()
+    assert rule.project, f"{rule_id} is not a project rule"
+    return project_rule_findings(idx, [rule]), idx
+
+
+class TestRecompileHazard:
+    def test_len_into_cache_key_fires(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                programs = {}
+
+                def admit(prompt):
+                    key = ("admit", len(prompt))
+                    programs[key] = 1
+                """
+            },
+            "ZNC014",
+        )
+        assert ids(fs) == ["ZNC014"]
+        assert "len(...)" in fs[0].message
+        assert "programs" in fs[0].message
+
+    def test_bucketed_key_stays_quiet(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                LADDER = (16, 32, 64)
+                programs = {}
+
+                def bucket_for(n, ladder):
+                    for rung in ladder:
+                        if n <= rung:
+                            return rung
+                    return ladder[-1]
+
+                def admit(prompt):
+                    key = ("admit", bucket_for(len(prompt), LADDER))
+                    programs[key] = 1
+                """
+            },
+            "ZNC014",
+        )
+        assert fs == []
+
+    def test_rebinding_through_bucket_is_flow_sensitive(self):
+        """``n = len(p); n = bucket_for(n, L)`` must be bounded at
+        later uses — the last textual assignment before the use wins."""
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                cache = {}
+
+                def admit(p):
+                    n = len(p)
+                    n = bucket_for(n, (8, 16))
+                    cache[n] = 1
+                """
+            },
+            "ZNC014",
+        )
+        assert fs == []
+
+    def test_ledger_call_key_fires(self):
+        fs, _ = run_project(
+            {
+                "services/engine.py": """
+                class Engine:
+                    def admit(self, prompt):
+                        self._timed_program(
+                            ("admit", len(prompt)), run, prompt
+                        )
+                """
+            },
+            "ZNC014",
+        )
+        assert ids(fs) == ["ZNC014"]
+        assert "_timed_program" in fs[0].message
+
+    def test_wallclock_static_arg_fires(self):
+        fs, _ = run_project(
+            {
+                "pkg/mod.py": """
+                import jax
+                import time
+
+                def step(x, n):
+                    return x * n
+
+                fast = jax.jit(step, static_argnums=(1,))
+
+                def run(x):
+                    return fast(x, int(time.time()))
+                """
+            },
+            "ZNC014",
+        )
+        assert ids(fs) == ["ZNC014"]
+        assert "wall-clock" in fs[0].message
+        assert "static argument 'n'" in fs[0].message
+
+    def test_static_arg_resolved_cross_module(self):
+        fs, _ = run_project(
+            {
+                "liba.py": """
+                def step(x, width):
+                    return x * width
+                """,
+                "libb.py": """
+                import jax
+                import liba
+
+                fast = jax.jit(liba.step, static_argnames=("width",))
+
+                def run(x, prompt):
+                    return fast(x, width=len(prompt))
+                """,
+            },
+            "ZNC014",
+        )
+        assert ids(fs) == ["ZNC014"]
+        assert fs[0].path == "libb.py"
+
+    def test_interprocedural_param_taint_fires(self):
+        """A helper sized by its parameter fires when a call site
+        passes ``len(...)`` — the origin names the call site."""
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import numpy as np
+
+                def make_buffer(n):
+                    return np.zeros((n, 4))
+
+                def admit(prompt):
+                    return make_buffer(len(prompt))
+                """
+            },
+            "ZNC014",
+        )
+        assert ids(fs) == ["ZNC014"]
+        assert "via call at services/mod.py" in fs[0].message
+
+    def test_shape_ctor_outside_serving_tier_stays_quiet(self):
+        """Loader-tier dataset-sized host buffers are one-time
+        allocations, not per-request compile drivers."""
+        fs, _ = run_project(
+            {
+                "loader/mod.py": """
+                import numpy as np
+
+                def materialize(items):
+                    return np.zeros((len(items), 4))
+                """
+            },
+            "ZNC014",
+        )
+        assert fs == []
+
+    def test_traced_context_shapes_stay_quiet(self):
+        """``jnp.zeros(...)`` INSIDE jitted code is trace
+        polymorphism, not a host recompile driver."""
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import jax
+                import jax.numpy as jnp
+
+                @jax.jit
+                def step(xs):
+                    return jnp.zeros((len(xs), 4))
+                """
+            },
+            "ZNC014",
+        )
+        assert fs == []
+
+    def test_min_clamp_is_a_boundary(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                cache = {}
+
+                def admit(prompt):
+                    cache[min(len(prompt), 64)] = 1
+                """
+            },
+            "ZNC014",
+        )
+        assert fs == []
+
+    def test_loop_counter_key_fires(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                cache = {}
+
+                def admit(prompts):
+                    for i, p in enumerate(prompts):
+                        cache[("row", i)] = p
+                """
+            },
+            "ZNC014",
+        )
+        assert ids(fs) == ["ZNC014"]
+        assert "enumerate" in fs[0].message
+
+    def test_unknown_provenance_stays_quiet(self):
+        """Config plumbing (constructor params, fields with no
+        stores) is UNKNOWN — never fired on."""
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                class Engine:
+                    def __init__(self, batch_size):
+                        self.batch_size = batch_size
+                        self._programs = {}
+
+                    def admit(self):
+                        self._programs[("chunk", self.batch_size)] = 1
+                """
+            },
+            "ZNC014",
+        )
+        assert fs == []
+
+    def test_pragma_suppresses(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                cache = {}
+
+                def admit(prompt):
+                    cache[len(prompt)] = 1  # znicz-check: disable=ZNC014
+                """
+            },
+            "ZNC014",
+        )
+        assert fs == []
+
+
+class TestLockOrder:
+    CYCLE = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats_lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    with self._stats_lock:
+                        pass
+
+            def stats(self):
+                with self._stats_lock:
+                    with self._lock:
+                        pass
+        """
+
+    def test_opposite_nesting_fires(self):
+        fs, _ = run_project({"services/mod.py": self.CYCLE}, "ZNC015")
+        assert ids(fs) == ["ZNC015"]
+        assert "lock-order cycle" in fs[0].message
+        assert "_lock" in fs[0].message and "_stats_lock" in fs[0].message
+
+    def test_consistent_order_stays_quiet(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+
+                class Engine:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._stats_lock = threading.Lock()
+
+                    def tick(self):
+                        with self._lock:
+                            with self._stats_lock:
+                                pass
+
+                    def stats(self):
+                        with self._lock:
+                            with self._stats_lock:
+                                pass
+                """
+            },
+            "ZNC015",
+        )
+        assert fs == []
+
+    def test_cycle_through_method_call(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+
+                class Engine:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b_lock = threading.Lock()
+
+                    def _grab_b(self):
+                        with self._b_lock:
+                            pass
+
+                    def tick(self):
+                        with self._a:
+                            self._grab_b()
+
+                    def other(self):
+                        with self._b_lock:
+                            with self._a:
+                                pass
+                """
+            },
+            "ZNC015",
+        )
+        assert ids(fs) == ["ZNC015"]
+        assert "self._grab_b()" in fs[0].message
+
+    def test_cross_class_cycle_via_typed_attr(self):
+        """Router holds its lock and calls into the registry (which
+        locks); a registry sweep hook calls back into the router —
+        the classic cross-object deadlock."""
+        fs, _ = run_project(
+            {
+                "cluster/router.py": """
+                import threading
+                from cluster.registry import Registry
+
+                class Router:
+                    def __init__(self):
+                        self._rr_lock = threading.Lock()
+                        self.registry = Registry(self)
+
+                    def route(self):
+                        with self._rr_lock:
+                            self.registry.note()
+
+                    def on_sweep(self):
+                        with self._rr_lock:
+                            pass
+                """,
+                "cluster/registry.py": """
+                import threading
+
+                class Registry:
+                    def __init__(self, router):
+                        self.router: "Router" = router
+                        self._lock = threading.Lock()
+
+                    def note(self):
+                        with self._lock:
+                            pass
+
+                    def sweep(self):
+                        with self._lock:
+                            self.router.on_sweep()
+                """,
+                "cluster/__init__.py": "",
+            },
+            "ZNC015",
+        )
+        assert ids(fs) == ["ZNC015"]
+        assert "Router._rr_lock" in fs[0].message
+        assert "Registry._lock" in fs[0].message
+
+    def test_self_reacquisition_fires(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def _inner(self):
+                        with self._lock:
+                            pass
+
+                    def close(self):
+                        with self._lock:
+                            self._inner()
+                """
+            },
+            "ZNC015",
+        )
+        assert ids(fs) == ["ZNC015"]
+        assert "self-deadlock" in fs[0].message
+
+    def test_rlock_reacquisition_stays_quiet(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.RLock()
+
+                    def _inner(self):
+                        with self._lock:
+                            pass
+
+                    def close(self):
+                        with self._lock:
+                            self._inner()
+                """
+            },
+            "ZNC015",
+        )
+        assert fs == []
+
+    def test_out_of_scope_module_stays_quiet(self):
+        fs, _ = run_project({"workflow/mod.py": self.CYCLE}, "ZNC015")
+        assert fs == []
+
+    def test_pragma_suppresses(self):
+        # the finding anchors at the FIRST edge's acquisition site (in
+        # sorted lock order) — a pragma on that line suppresses it
+        fired, _ = run_project({"services/mod.py": self.CYCLE}, "ZNC015")
+        anchor_line = fired[0].line
+        lines = textwrap.dedent(self.CYCLE).splitlines()
+        lines[anchor_line - 1] += "  # znicz-check: disable=ZNC015"
+        fs, _ = run_project(
+            {"services/mod.py": "\n".join(lines)}, "ZNC015"
+        )
+        assert fs == []
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_fires(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+                import time
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def tick(self):
+                        with self._lock:
+                            time.sleep(0.05)
+                """
+            },
+            "ZNC016",
+        )
+        assert ids(fs) == ["ZNC016"]
+        assert "time.sleep()" in fs[0].message
+
+    def test_sleep_outside_lock_stays_quiet(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+                import time
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.n = 0
+
+                    def tick(self):
+                        time.sleep(0.05)
+                        with self._lock:
+                            self.n += 1
+                """
+            },
+            "ZNC016",
+        )
+        assert fs == []
+
+    def test_urlopen_through_helper_fires_with_chain(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+                import urllib.request
+
+                def push(url):
+                    return urllib.request.urlopen(url, timeout=5)
+
+                class Pusher:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.url = "http://x/push"
+
+                    def flush(self):
+                        with self._lock:
+                            push(self.url)
+                """
+            },
+            "ZNC016",
+        )
+        assert ids(fs) == ["ZNC016"]
+        assert "urlopen" in fs[0].message
+        assert "push()" in fs[0].message
+
+    def test_queue_get_with_timeout_under_lock_fires(self):
+        """A BOUNDED wait under a lock still stalls every peer for
+        the bound — timeout does not excuse ZNC016 (unlike ZNC010)."""
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.q = make_queue()
+
+                    def tick(self):
+                        with self._lock:
+                            return self.q.get(timeout=1.0)
+                """
+            },
+            "ZNC016",
+        )
+        assert ids(fs) == ["ZNC016"]
+
+    def test_dict_get_homonym_stays_quiet(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.d = {}
+
+                    def lookup(self, k):
+                        with self._lock:
+                            return self.d.get(k)
+                """
+            },
+            "ZNC016",
+        )
+        assert fs == []
+
+    def test_out_of_scope_stays_quiet(self):
+        fs, _ = run_project(
+            {
+                "workflow/mod.py": """
+                import threading
+                import time
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def tick(self):
+                        with self._lock:
+                            time.sleep(0.05)
+                """
+            },
+            "ZNC016",
+        )
+        assert fs == []
+
+    def test_pragma_suppresses(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+                import time
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def tick(self):
+                        with self._lock:
+                            time.sleep(0.01)  # znicz-check: disable=ZNC016
+                """
+            },
+            "ZNC016",
+        )
+        assert fs == []
+
+
+class TestExplainExamples:
+    """The --explain registry metadata is EXECUTABLE documentation:
+    every rule ships a firing example and a minimally-edited quiet
+    twin, and this test runs both — the one source of truth cannot
+    drift from the analyzer's behavior."""
+
+    @pytest.mark.parametrize("rule_id", sorted(RULES))
+    def test_example_fires_and_quiet_twin_is_quiet(self, rule_id):
+        from znicz_tpu.analysis.project import (
+            ProjectIndex,
+            project_rule_findings,
+        )
+
+        cls = RULES[rule_id]
+        assert cls.example_fire.strip(), f"{rule_id} has no example"
+        assert cls.example_quiet.strip(), f"{rule_id} has no quiet twin"
+
+        def run_example(src):
+            idx = ProjectIndex("/example")
+            for rel, s in cls.example_support_files.items():
+                idx.add_module(textwrap.dedent(s), rel)
+            idx.add_module(textwrap.dedent(src), cls.example_path)
+            idx.link()
+            rule = cls()
+            if cls.project:
+                out = project_rule_findings(idx, [rule])
+            else:
+                out = []
+                for info in idx.modules.values():
+                    for f in rule.check(info):
+                        if not info.suppressed(f):
+                            out.append(f)
+                out = idx.relocate(out)
+            return [f for f in out if f.rule == rule_id]
+
+        assert run_example(cls.example_fire), (
+            f"{rule_id}'s example_fire does not fire"
+        )
+        assert run_example(cls.example_quiet) == [], (
+            f"{rule_id}'s example_quiet fires"
+        )
+
+    def test_explain_cli_prints_examples(self, capsys):
+        from znicz_tpu.analysis.__main__ import main
+
+        rc = main(["--explain", "ZNC014"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ZNC014" in out
+        assert "FIRES" in out and "QUIET" in out
+        assert "bucket_for" in out
+
+    def test_explain_unknown_rule_is_usage_error(self):
+        from znicz_tpu.analysis.__main__ import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["--explain", "ZNC999"])
+        assert exc.value.code == 2
+
+
+class TestLockModelExceptHandlers:
+    """Review regression: ExceptHandler (and match_case) bodies are
+    neither stmt nor expr — a naive child partition routed them around
+    the held-lock walk, blinding ZNC015/016 to exactly the error-path
+    retry/backoff code where sleep-under-lock lives."""
+
+    def test_blocking_inside_except_handler_fires(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+                import time
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def tick(self):
+                        try:
+                            work()
+                        except Exception:
+                            with self._lock:
+                                time.sleep(0.05)
+                """
+            },
+            "ZNC016",
+        )
+        assert ids(fs) == ["ZNC016"]
+
+    def test_lock_order_inside_except_handler_fires(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+
+                class Door:
+                    def __init__(self):
+                        self._a_lock = threading.Lock()
+                        self._b_lock = threading.Lock()
+
+                    def tick(self):
+                        with self._a_lock:
+                            with self._b_lock:
+                                pass
+
+                    def recover(self):
+                        try:
+                            work()
+                        except Exception:
+                            with self._b_lock:
+                                with self._a_lock:
+                                    pass
+                """
+            },
+            "ZNC015",
+        )
+        assert ids(fs) == ["ZNC015"]
+
+    def test_blocking_inside_match_case_fires(self):
+        fs, _ = run_project(
+            {
+                "services/mod.py": """
+                import threading
+                import time
+
+                class Door:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def tick(self, kind):
+                        with self._lock:
+                            match kind:
+                                case "slow":
+                                    time.sleep(0.05)
+                                case _:
+                                    pass
+                """
+            },
+            "ZNC016",
+        )
+        assert ids(fs) == ["ZNC016"]

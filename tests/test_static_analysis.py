@@ -21,6 +21,8 @@ import os
 import textwrap
 import time
 
+import pytest
+
 import znicz_tpu
 from znicz_tpu.analysis import (
     RULES,
@@ -663,3 +665,358 @@ class TestCliSurfaces:
         assert rc == 0
         err = capsys.readouterr().err
         assert "s]" in err and "finding" in err
+
+
+# -- PR 15: dataflow rules, incremental cache, baseline versioning --------
+
+
+def test_dataflow_and_concurrency_rules_are_registered():
+    for rid in ("ZNC014", "ZNC015", "ZNC016"):
+        assert rid in RULES
+        assert RULES[rid].project, f"{rid} must be a project rule"
+        assert RULES[rid].severity in ("error", "warning")
+
+
+def test_every_registered_rule_has_a_docs_row():
+    """Docs-drift lint: a rule without a catalog row in
+    docs/STATIC_ANALYSIS.md is undocumented debt (PR 9 almost shipped
+    ZNC013 without one)."""
+    docs = os.path.join(REPO_ROOT, "docs", "STATIC_ANALYSIS.md")
+    with open(docs, encoding="utf-8") as f:
+        text = f.read()
+    missing = [
+        rid for rid in sorted(RULES) if f"| {rid} " not in text
+    ]
+    assert not missing, (
+        f"rules missing a docs/STATIC_ANALYSIS.md catalog row: {missing}"
+    )
+
+
+def test_every_rule_ships_explain_examples():
+    """--explain is registry-driven; an example-less rule would print
+    an empty entry (the examples themselves are executed per-rule in
+    test_analysis_rules.py)."""
+    for rid, cls in sorted(RULES.items()):
+        assert cls.example_fire.strip(), f"{rid} has no example_fire"
+        assert cls.example_quiet.strip(), f"{rid} has no example_quiet"
+
+
+class TestIncrementalCache:
+    def test_cold_equals_warm_on_the_real_package_and_warm_is_fast(
+        self, tmp_path
+    ):
+        """The tier-1 cache contract: a cold cached run and a warm one
+        return IDENTICAL findings over this repo, and the warm run
+        completes well inside the 5s --changed budget."""
+        from znicz_tpu.analysis.cache import analyze_project_cached
+
+        cache = tmp_path / "cache.json"
+        cold, index, stats_cold = analyze_project_cached(
+            [PKG_DIR], root=REPO_ROOT, cache_path=str(cache)
+        )
+        assert stats_cold["mode"] == "cold"
+        assert index is not None
+        t0 = time.monotonic()
+        warm, index2, stats_warm = analyze_project_cached(
+            [PKG_DIR], root=REPO_ROOT, cache_path=str(cache)
+        )
+        warm_s = time.monotonic() - t0
+        assert stats_warm["mode"] == "warm"
+        assert stats_warm["analyzed"] == 0
+        assert index2 is None  # nothing was parsed
+        assert warm == cold
+        assert warm_s < 5.0, f"warm cached run took {warm_s:.2f}s"
+
+    def test_edit_one_file_reanalyzes_only_it(self, tmp_path):
+        """Edit one file -> only its findings recompute; cross-module
+        results (a ZNC001 anchored in the UNCHANGED definer) ride the
+        cache unchanged."""
+        from znicz_tpu.analysis.cache import analyze_project_cached
+
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "liba.py").write_text(
+            "def step(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        (proj / "libb.py").write_text(
+            "import jax\nimport liba\n\nfast = jax.jit(liba.step)\n"
+        )
+        cache = tmp_path / "cache.json"
+        cold, _, stats = analyze_project_cached(
+            [str(proj)], root=str(proj), cache_path=str(cache)
+        )
+        assert stats["mode"] == "cold"
+        assert [f.rule for f in cold] == ["ZNC001"]
+        assert cold[0].path == "liba.py"
+
+        # touch only libb (the APPLIER): liba's findings are reused
+        (proj / "libb.py").write_text(
+            "import jax\nimport liba\n\n"
+            "fast = jax.jit(liba.step)\n# touched\n"
+        )
+        warm, _, stats = analyze_project_cached(
+            [str(proj)], root=str(proj), cache_path=str(cache)
+        )
+        assert stats["mode"] == "partial"
+        assert stats["analyzed"] == 1 and stats["reused"] == 1
+        assert [(f.rule, f.path, f.line) for f in warm] == [
+            (f.rule, f.path, f.line) for f in cold
+        ]
+
+    def test_marks_digest_invalidates_on_cross_module_change(
+        self, tmp_path
+    ):
+        """Removing the jit application in libb must ALSO invalidate
+        (unchanged) liba — its traced marks changed even though its
+        bytes did not.  This is the staleness bug the digest exists to
+        prevent."""
+        from znicz_tpu.analysis.cache import analyze_project_cached
+
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "liba.py").write_text(
+            "def step(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        (proj / "libb.py").write_text(
+            "import jax\nimport liba\n\nfast = jax.jit(liba.step)\n"
+        )
+        cache = tmp_path / "cache.json"
+        cold, _, _ = analyze_project_cached(
+            [str(proj)], root=str(proj), cache_path=str(cache)
+        )
+        assert [f.rule for f in cold] == ["ZNC001"]
+        (proj / "libb.py").write_text("import liba\n")
+        warm, _, stats = analyze_project_cached(
+            [str(proj)], root=str(proj), cache_path=str(cache)
+        )
+        assert warm == []  # liba re-analyzed unmarked, finding gone
+        assert stats["analyzed"] == 2  # libb (hash) AND liba (digest)
+
+    def test_corrupt_cache_degrades_to_cold(self, tmp_path):
+        from znicz_tpu.analysis.cache import analyze_project_cached
+
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "m.py").write_text("def f(x):\n    return x\n")
+        cache = tmp_path / "cache.json"
+        cache.write_text("{not json")
+        findings, index, stats = analyze_project_cached(
+            [str(proj)], root=str(proj), cache_path=str(cache)
+        )
+        assert findings == [] and stats["mode"] == "cold"
+        assert index is not None
+
+    def test_cli_uses_cache_and_reports_it(self, tmp_path, capsys):
+        from znicz_tpu.analysis.__main__ import main
+
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "m.py").write_text("def f(x):\n    return x\n")
+        argv = [str(proj), "--root", str(proj), "--no-baseline"]
+        assert main(argv) == 0
+        err = capsys.readouterr().err
+        assert "cache cold" in err
+        assert main(argv) == 0
+        err = capsys.readouterr().err
+        assert "cache warm" in err
+        # the cache landed at the documented default location
+        assert (proj / "tools" / "znicz_check_cache.json").exists()
+
+    def test_select_subset_bypasses_the_cache(self, tmp_path, capsys):
+        from znicz_tpu.analysis.__main__ import main
+
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "m.py").write_text("def f(x):\n    return x\n")
+        argv = [
+            str(proj), "--root", str(proj), "--no-baseline",
+            "--select", "ZNC008",
+        ]
+        assert main(argv) == 0
+        err = capsys.readouterr().err
+        assert "cache" not in err
+
+
+class TestBaselineVersioning:
+    def test_write_baseline_records_analyzer_stamp(self, tmp_path):
+        from znicz_tpu.analysis.engine import (
+            ANALYZER_VERSION,
+            baseline_meta,
+            stale_baseline_meta,
+            write_baseline,
+        )
+
+        path = str(tmp_path / "b.json")
+        write_baseline([], path)
+        meta = baseline_meta(path)
+        assert meta["version"] == ANALYZER_VERSION
+        assert meta["rules"] == sorted(RULES)
+        assert stale_baseline_meta(path) is None
+
+    def test_unstamped_baseline_is_stale(self, tmp_path):
+        from znicz_tpu.analysis.engine import stale_baseline_meta
+
+        path = tmp_path / "b.json"
+        path.write_text('{"version": 1, "findings": {}}\n')
+        note = stale_baseline_meta(str(path))
+        assert note is not None and "--write-baseline" in note
+
+    def test_baseline_missing_new_rules_is_stale_and_names_them(
+        self, tmp_path
+    ):
+        from znicz_tpu.analysis.engine import stale_baseline_meta
+
+        path = tmp_path / "b.json"
+        rules = [r for r in sorted(RULES) if r != "ZNC016"]
+        path.write_text(
+            json.dumps(
+                {
+                    "version": 1,
+                    "analyzer": {"version": "2.0", "rules": rules},
+                    "findings": {},
+                }
+            )
+        )
+        note = stale_baseline_meta(str(path))
+        assert note is not None and "ZNC016" in note
+
+    def test_cli_warns_on_stale_baseline(self, tmp_path, capsys):
+        from znicz_tpu.analysis.__main__ import main
+
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "m.py").write_text("def f(x):\n    return x\n")
+        stale = tmp_path / "b.json"
+        stale.write_text('{"version": 1, "findings": {}}\n')
+        rc = main(
+            [
+                str(proj), "--root", str(proj),
+                "--baseline", str(stale),
+            ]
+        )
+        assert rc == 0
+        assert "warning:" in capsys.readouterr().err
+
+    def test_committed_baseline_is_not_stale(self):
+        """Adding a rule without regenerating the committed baseline
+        fails HERE, not as a silent suppression gap."""
+        from znicz_tpu.analysis.engine import stale_baseline_meta
+
+        assert stale_baseline_meta(BASELINE) is None
+
+
+class TestProjectRuleAcceptanceFixtures:
+    """Seeded fire + minimally-edited quiet twins for ZNC014/015/016
+    through the REAL analyze_project entry point (file-based, like
+    PR 9's cross-module acceptance pair) — proving the project rules
+    ride the full pipeline: suppression, sorting, --changed filtering."""
+
+    RECOMPILE_FIRE = """
+        programs = {}
+
+        def admit(prompt):
+            programs[("admit", len(prompt))] = 1
+        """
+    RECOMPILE_QUIET = """
+        programs = {}
+
+        def admit(prompt):
+            programs[("admit", bucket_for(len(prompt), (16, 32)))] = 1
+        """
+    DEADLOCK_FIRE = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats_lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    with self._stats_lock:
+                        pass
+
+            def stats(self):
+                with self._stats_lock:
+                    with self._lock:
+                        pass
+        """
+    DEADLOCK_QUIET = DEADLOCK_FIRE.replace(
+        "with self._stats_lock:\n                    with self._lock:",
+        "with self._lock:\n                    with self._stats_lock:",
+    )
+    BLOCKING_FIRE = """
+        import threading
+        import time
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.01)
+                    self.n += 1
+        """
+    BLOCKING_QUIET = """
+        import threading
+        import time
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def tick(self):
+                time.sleep(0.01)
+                with self._lock:
+                    self.n += 1
+        """
+
+    def _services(self, tmp_path, src):
+        (tmp_path / "services").mkdir(exist_ok=True)
+        _write(tmp_path, "services/mod.py", src)
+        return analyze_project(
+            [str(tmp_path)], root=str(tmp_path)
+        )[0]
+
+    @pytest.mark.parametrize(
+        "fire,quiet,rule",
+        [
+            ("RECOMPILE_FIRE", "RECOMPILE_QUIET", "ZNC014"),
+            ("DEADLOCK_FIRE", "DEADLOCK_QUIET", "ZNC015"),
+            ("BLOCKING_FIRE", "BLOCKING_QUIET", "ZNC016"),
+        ],
+    )
+    def test_fire_and_quiet_twin(self, tmp_path, fire, quiet, rule):
+        findings = self._services(tmp_path, getattr(self, fire))
+        assert [f.rule for f in findings] == [rule]
+        assert findings[0].path == "services/mod.py"
+        findings = self._services(tmp_path, getattr(self, quiet))
+        assert findings == []
+
+    def test_report_paths_filters_project_findings(self, tmp_path):
+        """--changed semantics: a ZNC015 finding survives the filter
+        only when its ANCHOR file is in the changed set."""
+        (tmp_path / "services").mkdir()
+        _write(tmp_path, "services/mod.py", self.DEADLOCK_FIRE)
+        _write(tmp_path, "other.py", "X = 1\n")
+        kept, _ = analyze_project(
+            [str(tmp_path)],
+            root=str(tmp_path),
+            report_paths={"services/mod.py"},
+        )
+        assert [f.rule for f in kept] == ["ZNC015"]
+        dropped, _ = analyze_project(
+            [str(tmp_path)],
+            root=str(tmp_path),
+            report_paths={"other.py"},
+        )
+        assert dropped == []

@@ -29,12 +29,18 @@ Intentional violations are exempted inline::
 See docs/STATIC_ANALYSIS.md for the rule catalog and baseline workflow.
 """
 
+from znicz_tpu.analysis.cache import (  # noqa: F401
+    analyze_project_cached,
+)
 from znicz_tpu.analysis.engine import (  # noqa: F401
+    ANALYZER_VERSION,
     Finding,
     analyze_paths,
     analyze_source,
+    baseline_meta,
     load_baseline,
     new_findings,
+    stale_baseline_meta,
     write_baseline,
 )
 from znicz_tpu.analysis.project import (  # noqa: F401

@@ -19,11 +19,19 @@ import pathlib
 import subprocess
 import sys
 import time
+from typing import Optional
 
+import textwrap
+
+from znicz_tpu.analysis.cache import (
+    DEFAULT_CACHE_RELPATH,
+    analyze_project_cached,
+)
 from znicz_tpu.analysis.engine import (
     load_baseline,
     new_findings,
     stale_baseline_entries,
+    stale_baseline_meta,
     write_baseline,
 )
 from znicz_tpu.analysis.project import analyze_project
@@ -47,6 +55,45 @@ SARIF_SCHEMA = (
 
 def _split_ids(value):
     return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def explain_rule(rule_id: str) -> Optional[str]:
+    """The ``--explain`` text for one rule, entirely from registry
+    metadata (class attributes + the rule module's docstring) — no
+    second source of truth to drift.  None for an unknown id."""
+    cls = RULES.get(rule_id)
+    if cls is None:
+        return None
+    mod = sys.modules.get(cls.__module__)
+    doc = (getattr(mod, "__doc__", "") or "").strip()
+    lines = [
+        f"{rule_id} [{cls.severity}] {cls.title}",
+        "scope: " + ("project-wide" if cls.project else "per-module"),
+        "",
+        doc,
+    ]
+    if cls.example_fire.strip():
+        lines += [
+            "",
+            f"FIRES ({cls.example_path}):",
+            textwrap.indent(
+                textwrap.dedent(cls.example_fire).strip(), "    "
+            ),
+        ]
+        for path, src in sorted(cls.example_support_files.items()):
+            lines += [
+                f"  with sibling {path}:",
+                textwrap.indent(textwrap.dedent(src).strip(), "    "),
+            ]
+    if cls.example_quiet.strip():
+        lines += [
+            "",
+            "QUIET (minimally edited twin):",
+            textwrap.indent(
+                textwrap.dedent(cls.example_quiet).strip(), "    "
+            ),
+        ]
+    return "\n".join(lines)
 
 
 def _changed_files(ref: str, root: str):
@@ -232,6 +279,25 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     parser.add_argument(
+        "--explain",
+        metavar="RULE_ID",
+        help="print one rule's catalog entry plus a firing example "
+        "and its quiet twin (from registry metadata), then exit",
+    )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="skip the incremental analysis cache (always re-analyze)",
+    )
+    parser.add_argument(
+        "--cache",
+        metavar="PATH",
+        default=None,
+        help="incremental cache file (default: "
+        f"<root>/{DEFAULT_CACHE_RELPATH}; content-hash keyed, safe "
+        "to delete, never commit)",
+    )
+    parser.add_argument(
         "--root",
         default=REPO_ROOT,
         help="directory finding paths are reported relative to "
@@ -243,6 +309,15 @@ def main(argv=None) -> int:
         for rule_id in sorted(RULES):
             cls = RULES[rule_id]
             print(f"{rule_id} [{cls.severity}] {cls.title}")
+        return 0
+
+    if args.explain:
+        text = explain_rule(args.explain.strip())
+        if text is None:
+            parser.error(
+                f"unknown rule id: {args.explain} (see --list-rules)"
+            )
+        print(text)
         return 0
 
     default_target = os.path.join(REPO_ROOT, "znicz_tpu")
@@ -277,14 +352,28 @@ def main(argv=None) -> int:
         except (RuntimeError, OSError) as exc:
             parser.error(f"--changed {args.changed}: {exc}")
 
+    # the cache is only engaged for the FULL rule set: a --select/
+    # --ignore subset would thrash one shared cache between two
+    # incompatible finding universes
+    use_cache = not args.no_cache and not (args.select or args.ignore)
+    cache_stats = None
     t0 = time.monotonic()
     try:
-        findings, _index = analyze_project(
-            paths,
-            root=args.root,
-            rules=rules,
-            report_paths=report_paths,
-        )
+        if use_cache:
+            findings, _index, cache_stats = analyze_project_cached(
+                paths,
+                root=args.root,
+                rules=rules,
+                report_paths=report_paths,
+                cache_path=args.cache,
+            )
+        else:
+            findings, _index = analyze_project(
+                paths,
+                root=args.root,
+                rules=rules,
+                report_paths=report_paths,
+            )
     except FileNotFoundError as exc:
         parser.error(str(exc))
     wall_s = time.monotonic() - t0
@@ -300,6 +389,12 @@ def main(argv=None) -> int:
     baseline = (
         load_baseline(args.baseline) if not args.no_baseline else None
     )
+    if baseline is not None:
+        staleness = stale_baseline_meta(args.baseline)
+        if staleness is not None:
+            # never silently trust a "clean" verdict vetted under a
+            # different (older) rule set
+            print(f"warning: {staleness}", file=sys.stderr)
     report = (
         findings if baseline is None else new_findings(findings, baseline)
     )
@@ -334,6 +429,12 @@ def main(argv=None) -> int:
                 f"vs {args.changed}"
             )
         summary += f" [{wall_s:.2f}s]"
+        if cache_stats is not None:
+            summary += (
+                f" (cache {cache_stats['mode']}: "
+                f"{cache_stats['reused']} reused, "
+                f"{cache_stats['analyzed']} analyzed)"
+            )
         print(summary, file=sys.stderr)
 
     return 1 if report else 0

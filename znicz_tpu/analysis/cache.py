@@ -1,0 +1,300 @@
+"""Incremental analysis cache: content-hash keyed per-file findings.
+
+The tier-1 gate runs the whole-project analysis on every commit and
+the ``--changed`` pre-push loop runs it on every edit; both pay the
+full parse + rule cost even when almost nothing changed.  This module
+makes the re-run cost proportional to the EDIT, not the repo, without
+ever trading soundness for speed:
+
+* **Fully warm** — the analyzer signature (analyzer version + the
+  source hashes of every analysis module and registered rule + the
+  mesh-axis declarations ZNC003 consults) and the per-file content
+  manifest both match the cached run: the cached findings are returned
+  verbatim, no parsing, no rules.  Well under a second.
+* **Partially warm** — some files changed: the project index is still
+  built over EVERYTHING (cross-module marks must stay correct — the
+  ``--changed`` contract), but per-module rule execution is skipped
+  for every unchanged file whose **cross-module marks digest** also
+  matches.  The digest captures exactly what per-module rule output
+  depends on beyond the file's own bytes: each def's traced mark and
+  static-parameter set, and the chains anchored through the file
+  (including the ENTRY file's content hash, since relocation copies
+  the entry's symbol/snippet).  Project rules (dataflow, lock-order,
+  blocking-under-lock) always re-run against the fresh index — their
+  whole point is cross-module reasoning.
+
+Per-file findings are stored keyed by the module that PRODUCED them
+(post-suppression, post-relocation), so a chain finding re-anchored
+into another file is reused/invalidated with its producer.  The cache
+lives at ``tools/znicz_check_cache.json`` under the analysis root
+(gitignored; a corrupt or version-skewed file is ignored, never
+trusted), and the tier-1 gate asserts cold == warm equality so a
+staleness bug is a test failure, not a silently green CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from znicz_tpu.analysis.engine import (
+    ANALYZER_VERSION,
+    Finding,
+    iter_py_files,
+)
+from znicz_tpu.analysis.project import (
+    ProjectIndex,
+    project_rule_findings,
+)
+
+CACHE_VERSION = 1
+DEFAULT_CACHE_RELPATH = os.path.join("tools", "znicz_check_cache.json")
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def analyzer_signature(rules, root: str) -> str:
+    """One hash over everything that can change finding semantics
+    OTHER than the analyzed sources: analyzer version, the analysis
+    engine's own source files, each active rule's module source, and
+    the mesh-axis declarations ZNC003 reads from the analyzed tree."""
+    h = hashlib.sha256()
+    h.update(f"{ANALYZER_VERSION}:{CACHE_VERSION}:{root}".encode())
+    files: List[str] = []
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    for d in (pkg_dir, os.path.join(pkg_dir, "rules")):
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".py"):
+                files.append(os.path.join(d, name))
+    seen: Set[str] = set()
+    for rule in sorted(rules, key=lambda r: r.id):
+        h.update(rule.id.encode())
+        mod = sys.modules.get(type(rule).__module__)
+        f = getattr(mod, "__file__", None)
+        if f and f not in seen:
+            seen.add(f)
+            files.append(f)
+    for f in sorted(set(files)):
+        try:
+            with open(f, "rb") as fh:
+                h.update(_sha(fh.read()).encode())
+        except OSError:
+            h.update(b"?")
+    mesh = os.path.join(root, "znicz_tpu", "parallel", "mesh.py")
+    if os.path.exists(mesh):
+        with open(mesh, "rb") as fh:
+            h.update(_sha(fh.read()).encode())
+    return h.hexdigest()
+
+
+def _marks_digest(info, index: ProjectIndex, manifest: Dict[str, str]) -> str:
+    """Everything per-module rule output depends on beyond the file's
+    own bytes: traced marks (local + cross-module) per def, and the
+    chains whose helper lives here (with the ENTRY file's hash — the
+    relocated finding copies the entry's symbol and snippet)."""
+    marks = []
+    for node in ast.walk(info.tree):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            traced = info.traced.is_traced(node)
+            static = sorted(info.traced._static.get(node, ()))
+            marks.append(
+                [
+                    getattr(node, "lineno", 0),
+                    getattr(node, "col_offset", 0),
+                    traced,
+                    static,
+                ]
+            )
+    chains = []
+    for c in index._chains:
+        if c.info is not info:
+            continue
+        entry_path = c.entry_info.path if c.entry_info else ""
+        chains.append(
+            [
+                c.qual,
+                list(c.chain),
+                entry_path,
+                getattr(c.entry_fn, "lineno", 0) if c.entry_fn else 0,
+                manifest.get(entry_path, ""),
+            ]
+        )
+    payload = json.dumps([marks, sorted(chains)], sort_keys=True)
+    return _sha(payload.encode())
+
+
+def _module_findings(info, index: ProjectIndex, rules) -> List[Finding]:
+    """The per-module (non-project) rules over one cross-module-marked
+    module, suppressed and relocated — the unit the cache stores."""
+    out: List[Finding] = []
+    for rule in rules:
+        if getattr(rule, "project", False):
+            continue
+        for finding in rule.check(info):
+            if not info.suppressed(finding):
+                out.append(finding)
+    return index.relocate(out)
+
+
+def _dump(findings: Sequence[Finding]) -> List[Dict]:
+    return [dataclasses.asdict(f) for f in findings]
+
+
+def _load_findings(entries) -> List[Finding]:
+    return [Finding(**e) for e in entries]
+
+
+def load_cache(path: str) -> Optional[Dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    # znicz-check: disable=ZNC008 -- a missing/corrupt cache is the
+    # defined cold path: the caller re-analyzes and rewrites it
+    except (OSError, ValueError):  # znicz-check: disable=ZNC008
+        return None
+    if (
+        not isinstance(data, dict)
+        or data.get("cache_version") != CACHE_VERSION
+    ):
+        return None
+    return data
+
+
+def write_cache(path: str, data: Dict) -> None:
+    """Best-effort atomic write — a read-only checkout just runs cold."""
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    # znicz-check: disable=ZNC008 -- best-effort by contract: a
+    # read-only checkout (CI artifact dir) just runs cold next time
+    except OSError:  # znicz-check: disable=ZNC008
+        pass
+
+
+def analyze_project_cached(
+    paths: Sequence[str],
+    *,
+    root: Optional[str] = None,
+    rules: Optional[Sequence] = None,
+    report_paths: Optional[Set[str]] = None,
+    cache_path: Optional[str] = None,
+) -> Tuple[List[Finding], Optional[ProjectIndex], Dict]:
+    """:func:`~znicz_tpu.analysis.project.analyze_project` with the
+    incremental cache in front.  Returns ``(findings, index, stats)``
+    — ``index`` is None on the fully-warm path (nothing was parsed),
+    and ``stats`` reports ``{"mode": "cold"|"warm"|"partial",
+    "reused": n, "analyzed": n}`` for the CLI summary line."""
+    if rules is None:
+        from znicz_tpu.analysis.rules import get_rules
+
+        rules = get_rules()
+    root = os.path.abspath(root or os.getcwd())
+    if cache_path is None:
+        cache_path = os.path.join(root, DEFAULT_CACHE_RELPATH)
+    signature = analyzer_signature(rules, root)
+
+    sources: Dict[str, str] = {}
+    manifest: Dict[str, str] = {}
+    for file in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(file), root).replace(
+            os.sep, "/"
+        )
+        with open(file, "rb") as f:
+            raw = f.read()
+        manifest[rel] = _sha(raw)
+        sources[rel] = raw.decode("utf-8")
+
+    cached = load_cache(cache_path)
+    if (
+        cached is not None
+        and cached.get("signature") == signature
+        and cached.get("manifest") == manifest
+    ):
+        findings = _load_findings(cached.get("syntax", []))
+        for entries in cached.get("per_file", {}).values():
+            findings.extend(_load_findings(entries))
+        findings.extend(_load_findings(cached.get("project", [])))
+        if report_paths is not None:
+            findings = [f for f in findings if f.path in report_paths]
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        stats = {
+            "mode": "warm",
+            "reused": len(manifest),
+            "analyzed": 0,
+        }
+        return findings, None, stats
+
+    index = ProjectIndex(root)
+    for rel in sorted(sources):
+        index.add_module(sources[rel], rel)
+    index.link()
+
+    old_manifest = (cached or {}).get("manifest", {})
+    old_digests = (cached or {}).get("digests", {})
+    old_per_file = (cached or {}).get("per_file", {})
+    usable_cache = cached is not None and (
+        cached.get("signature") == signature
+    )
+
+    per_file: Dict[str, List[Dict]] = {}
+    digests: Dict[str, str] = {}
+    reused = analyzed = 0
+    findings: List[Finding] = list(index.syntax_findings)
+    for rel, info in index.modules.items():
+        digest = _marks_digest(info, index, manifest)
+        digests[rel] = digest
+        if (
+            usable_cache
+            and old_manifest.get(rel) == manifest[rel]
+            and old_digests.get(rel) == digest
+            and rel in old_per_file
+        ):
+            entries = old_per_file[rel]
+            reused += 1
+        else:
+            entries = _dump(_module_findings(info, index, rules))
+            analyzed += 1
+        per_file[rel] = entries
+        findings.extend(_load_findings(entries))
+
+    project = project_rule_findings(index, rules)
+    findings.extend(project)
+
+    write_cache(
+        cache_path,
+        {
+            "comment": (
+                "znicz-check incremental analysis cache; safe to "
+                "delete, never commit"
+            ),
+            "cache_version": CACHE_VERSION,
+            "signature": signature,
+            "manifest": manifest,
+            "digests": digests,
+            "per_file": per_file,
+            "project": _dump(project),
+            "syntax": _dump(index.syntax_findings),
+        },
+    )
+
+    if report_paths is not None:
+        findings = [f for f in findings if f.path in report_paths]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    stats = {
+        "mode": "cold" if not usable_cache else "partial",
+        "reused": reused,
+        "analyzed": analyzed,
+    }
+    return findings, index, stats

@@ -1,0 +1,573 @@
+"""Interprocedural value provenance: bounded vs unbounded-dynamic.
+
+The serving stack's most-defended invariant is *zero new compiled
+programs*: every value that becomes a compiled-program identity — a
+``static_argnums`` argument, a program-cache/ladder key, a host-side
+buffer shape — must range over a SMALL FIXED SET, or each new request
+shape silently compiles a new executable.  The repo's discipline is to
+pass every dynamic quantity through a bucketing boundary
+(``bucket_for``, the x2 window-ladder helpers) before it can reach one
+of those sites.  This module is the static model of that discipline: a
+three-point provenance lattice
+
+    BOUNDED  <  UNKNOWN  <  UNBOUNDED
+
+where **bounded** covers literals, module-level constants and the
+results of recognized bucketing/clamping calls; **unbounded-dynamic**
+covers the things that provably range with the request stream —
+``len(...)`` of anything, wall-clock reads, loop counters, array
+``.size`` reads — and **unknown** is everything the analysis cannot
+place (attribute state, unresolvable calls, parameters with no
+resolvable call sites).  Rules fire on UNBOUNDED only: unknown values
+stay quiet, so the layer errs toward false negatives, never noise.
+
+Propagation is demand-driven and interprocedural over the PR 9 project
+index: the provenance of an expression is computed only when a rule
+asks (sink sites are rare), pulling
+
+* local bindings (the last textual assignment before the use, so
+  ``n = len(p); n = bucket_for(n, L)`` is bounded at later uses),
+* function return summaries through the symbol table (a helper that
+  returns ``bucket_for(...)`` is itself a boundary; one that returns
+  ``len(x)`` taints its callers),
+* parameter provenance from the call graph (a parameter is unbounded
+  when any resolvable project-internal call site passes an unbounded
+  value — the origin string carries the call site),
+* attribute-field summaries by field NAME project-wide (``x.bucket``
+  is bounded iff every ``<expr>.bucket = ...`` store in the project
+  assigns a bounded value).
+
+Known false-negative shapes (documented in docs/STATIC_ANALYSIS.md):
+values smuggled through containers (``cfg["n"]``), dataclass/
+constructor-kwarg fields (no attribute STORE exists to summarize),
+``self.m()`` dispatch across modules, and any binding the one-pass
+textual-order approximation misreads inside a loop.  All of these
+degrade to UNKNOWN — quiet, never wrong-positive.
+
+Pure stdlib ``ast``; importing this module must never pull in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from znicz_tpu.analysis.context import (
+    _param_names,
+    _positional_names,
+    name_is_shadowed,
+)
+
+BOUNDED, UNKNOWN, UNBOUNDED = 0, 1, 2
+
+_LEVEL_NAME = {BOUNDED: "bounded", UNKNOWN: "unknown", UNBOUNDED: "unbounded"}
+
+
+class Prov(NamedTuple):
+    """A lattice point plus (for UNBOUNDED) the human-readable origin
+    of the dynamic value — carried through joins so the eventual
+    finding can say *which* request-varying quantity leaked."""
+
+    level: int
+    origin: str = ""
+
+
+P_BOUNDED = Prov(BOUNDED)
+P_UNKNOWN = Prov(UNKNOWN)
+
+
+def join(a: Prov, b: Prov) -> Prov:
+    return a if a.level >= b.level else b
+
+
+# a call whose terminal name matches is a BUCKETING/CLAMPING BOUNDARY:
+# its result ranges over the ladder, not the input.  Over-matching here
+# costs a false negative (quiet), never a false positive.
+_BUCKET_NAME_RE = re.compile(
+    r"(^|_)(bucket|bucketed|rung|window|clamp|snap|quantiz)", re.I
+)
+
+_WALL_CLOCK = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.process_time",
+}
+# builtins that pass their argument's provenance straight through
+_PASS_THROUGH = {"int", "float", "abs", "round"}
+
+_MAX_DEPTH = 16  # recursion bound across summaries/params/fields
+_MAX_FIELD_SITES = 32  # give up (UNKNOWN) on very hot field names
+
+
+def is_bucketing_name(dotted: Optional[str]) -> bool:
+    if not dotted:
+        return False
+    return bool(_BUCKET_NAME_RE.search(dotted.rpartition(".")[2]))
+
+
+class _FnBindings:
+    """One function's name bindings in textual order (the flow
+    approximation: the last assignment BEFORE the use wins)."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, fn: ast.AST):
+        # name -> [(lineno, kind, payload)] sorted by lineno
+        self.entries: Dict[str, List[Tuple[int, str, object]]] = {}
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # nested scopes bind their own names
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._bind_target(t, node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._bind_target(node.target, node.value)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                self._add(node.target.id, node.lineno, "aug", node)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._bind_loop_target(node.target, node.iter)
+            elif isinstance(node, ast.withitem) and isinstance(
+                node.optional_vars, ast.Name
+            ):
+                self._add(
+                    node.optional_vars.id,
+                    node.context_expr.lineno,
+                    "expr",
+                    node.context_expr,
+                )
+            stack.extend(ast.iter_child_nodes(node))
+        for lst in self.entries.values():
+            lst.sort(key=lambda e: e[0])
+
+    def _add(self, name: str, lineno: int, kind: str, payload) -> None:
+        self.entries.setdefault(name, []).append((lineno, kind, payload))
+
+    def _bind_target(self, target: ast.AST, value: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self._add(target.id, target.lineno, "expr", value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                # unpacking: each name gets the whole RHS's provenance
+                # (an element of an unbounded thing is unbounded-ish;
+                # of a bounded tuple, bounded) — conservative join
+                self._bind_target(elt, value)
+
+    def _bind_loop_target(self, target: ast.AST, it: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self._add(target.id, target.lineno, "for", (it, 0))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for i, elt in enumerate(target.elts):
+                if isinstance(elt, ast.Name):
+                    self._add(elt.id, elt.lineno, "for", (it, i))
+
+
+class DataflowIndex:
+    """Demand-driven provenance over a built
+    :class:`~znicz_tpu.analysis.project.ProjectIndex`."""
+
+    def __init__(self, index):
+        self.index = index
+        self._bindings: Dict[int, _FnBindings] = {}
+        self._module_consts: Dict[int, Dict[str, ast.AST]] = {}
+        self._summary_memo: Dict[int, Prov] = {}
+        self._param_memo: Dict[Tuple[int, str], Prov] = {}
+        self._field_memo: Dict[str, Prov] = {}
+        self._in_progress: set = set()
+        self._field_sites: Optional[Dict[str, List]] = None
+        self._callers: Optional[Dict[int, List]] = None
+
+    # -- lazy project-wide tables -----------------------------------------
+
+    def _field_assignments(self) -> Dict[str, List]:
+        """attr name -> [(info, fn, value expr)] over every
+        ``<expr>.attr = value`` store in the project (field-sensitive
+        by NAME, object-insensitive — the repo's attribute names are
+        distinctive enough that this is the right cost point)."""
+        if self._field_sites is None:
+            sites: Dict[str, List] = {}
+            for info in self.index.modules.values():
+                for node in ast.walk(info.tree):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute):
+                            sites.setdefault(t.attr, []).append(
+                                (info, info.enclosing_function(t), node.value)
+                            )
+            self._field_sites = sites
+        return self._field_sites
+
+    def _call_sites(self) -> Dict[int, List]:
+        if self._callers is None:
+            self._callers = self.index._call_sites()
+        return self._callers
+
+    def _consts(self, info) -> Dict[str, ast.AST]:
+        """Module-level simple assignments (last one wins)."""
+        key = id(info)
+        if key not in self._module_consts:
+            out: Dict[str, ast.AST] = {}
+            for node in info.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = node.value
+                elif (
+                    isinstance(node, ast.AnnAssign)
+                    and isinstance(node.target, ast.Name)
+                    and node.value is not None
+                ):
+                    out[node.target.id] = node.value
+            self._module_consts[key] = out
+        return self._module_consts[key]
+
+    def _fn_bindings(self, fn) -> _FnBindings:
+        key = id(fn)
+        if key not in self._bindings:
+            self._bindings[key] = _FnBindings(fn)
+        return self._bindings[key]
+
+    # -- provenance of one expression --------------------------------------
+
+    def prov(self, expr: ast.AST, info, depth: int = 0) -> Prov:
+        """Provenance of ``expr`` read in ``info``'s module."""
+        if depth > _MAX_DEPTH:
+            return P_UNKNOWN
+        if expr is None:
+            return P_BOUNDED
+        if isinstance(expr, ast.Constant):
+            return P_BOUNDED
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = P_BOUNDED
+            for elt in expr.elts:
+                out = join(out, self.prov(elt, info, depth + 1))
+            return out
+        if isinstance(expr, ast.Dict):
+            out = P_BOUNDED
+            for v in expr.values:
+                out = join(out, self.prov(v, info, depth + 1))
+            return out
+        if isinstance(expr, ast.Starred):
+            return self.prov(expr.value, info, depth + 1)
+        if isinstance(expr, ast.Name):
+            return self._name_prov(expr, info, depth)
+        if isinstance(expr, ast.Attribute):
+            return self._attr_prov(expr, info, depth)
+        if isinstance(expr, ast.Call):
+            return self._call_prov(expr, info, depth)
+        if isinstance(expr, ast.BinOp):
+            return join(
+                self.prov(expr.left, info, depth + 1),
+                self.prov(expr.right, info, depth + 1),
+            )
+        if isinstance(expr, ast.UnaryOp):
+            return self.prov(expr.operand, info, depth + 1)
+        if isinstance(expr, ast.BoolOp):
+            out = P_BOUNDED
+            for v in expr.values:
+                out = join(out, self.prov(v, info, depth + 1))
+            return out
+        if isinstance(expr, ast.Compare):
+            return P_BOUNDED  # a bool: two-valued by construction
+        if isinstance(expr, ast.IfExp):
+            return join(
+                self.prov(expr.body, info, depth + 1),
+                self.prov(expr.orelse, info, depth + 1),
+            )
+        if isinstance(expr, ast.Subscript):
+            # an ELEMENT of a container ranges over the container's
+            # contents: ladder[-1] is bounded whatever the index is
+            return self.prov(expr.value, info, depth + 1)
+        return P_UNKNOWN
+
+    # -- name / attribute / call resolution ---------------------------------
+
+    def _name_prov(self, expr: ast.Name, info, depth: int) -> Prov:
+        fn = info.enclosing_function(expr)
+        name = expr.id
+        cur = fn
+        while cur is not None:
+            entries = self._fn_bindings(cur).entries.get(name)
+            if entries:
+                before = [e for e in entries if e[0] < expr.lineno]
+                if before:
+                    return self._binding_prov(before[-1], info, cur, depth)
+                # textual use-before-binding (loop back-edge): join all
+                out = P_BOUNDED
+                for e in entries:
+                    out = join(out, self._binding_prov(e, info, cur, depth))
+                return out
+            if name in _param_names(cur):
+                return self._param_prov(cur, name, info, depth)
+            cur = info.enclosing_function(cur)
+        const = self._consts(info).get(name)
+        if const is not None:
+            return self.prov(const, info, depth + 1)
+        return P_UNKNOWN
+
+    def _binding_prov(self, entry, info, fn, depth: int) -> Prov:
+        lineno, kind, payload = entry
+        if kind == "expr":
+            return self.prov(payload, info, depth + 1)
+        if kind == "aug":
+            # n += ... inside a loop is a loop-accumulated counter:
+            # it ranges with the iteration count
+            node = payload
+            cur = info.parents.get(node)
+            while cur is not None and cur is not fn:
+                if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                    return Prov(
+                        UNBOUNDED,
+                        f"loop-accumulated counter at {info.path}:{lineno}",
+                    )
+                cur = info.parents.get(cur)
+            return self.prov(node.value, info, depth + 1)
+        if kind == "for":
+            it, pos = payload
+            if isinstance(it, ast.Call):
+                target = info.resolved(it.func)
+                if target == "range":
+                    out = P_BOUNDED
+                    for a in it.args:
+                        out = join(out, self.prov(a, info, depth + 1))
+                    if out.level == UNBOUNDED:
+                        return Prov(
+                            UNBOUNDED,
+                            f"loop counter over a dynamic range at "
+                            f"{info.path}:{lineno}",
+                        )
+                    # range over a config bound stays at the bound's
+                    # own provenance (UNKNOWN config never fires)
+                    return out
+                if target == "enumerate" and pos == 0:
+                    return Prov(
+                        UNBOUNDED,
+                        f"enumerate() loop counter at {info.path}:{lineno}",
+                    )
+            itp = self.prov(it, info, depth + 1)
+            if itp.level == UNBOUNDED:
+                return itp
+            return P_UNKNOWN
+        return P_UNKNOWN
+
+    def _attr_prov(self, expr: ast.Attribute, info, depth: int) -> Prov:
+        if expr.attr in ("size", "nbytes"):
+            return Prov(
+                UNBOUNDED,
+                f"array .{expr.attr} read at {info.path}:{expr.lineno}",
+            )
+        key = expr.attr
+        if key in self._field_memo:
+            return self._field_memo[key]
+        token = ("field", key)
+        if token in self._in_progress:
+            return P_UNKNOWN
+        sites = self._field_assignments().get(key)
+        if not sites:
+            return P_UNKNOWN  # constructor-kwarg field etc.: no stores
+        if len(sites) > _MAX_FIELD_SITES:
+            self._field_memo[key] = P_UNKNOWN
+            return P_UNKNOWN
+        self._in_progress.add(token)
+        try:
+            out = P_BOUNDED
+            for sinfo, _sfn, value in sites:
+                p = self.prov(value, sinfo, depth + 1)
+                if p.level == UNBOUNDED:
+                    p = Prov(
+                        UNBOUNDED,
+                        f"field '.{key}' assigned unbounded "
+                        f"({p.origin})",
+                    )
+                out = join(out, p)
+        finally:
+            self._in_progress.discard(token)
+        self._field_memo[key] = out
+        return out
+
+    def _call_prov(self, expr: ast.Call, info, depth: int) -> Prov:
+        resolved = info.resolved(expr.func)
+        if is_bucketing_name(resolved or self._attr_name(expr.func)):
+            return P_BOUNDED
+        if resolved in _WALL_CLOCK:
+            return Prov(
+                UNBOUNDED,
+                f"wall-clock read at {info.path}:{expr.lineno}",
+            )
+        if resolved == "len":
+            return Prov(
+                UNBOUNDED, f"len(...) at {info.path}:{expr.lineno}"
+            )
+        if resolved == "min":
+            # clamping against any bounded bound caps the range
+            provs = [self.prov(a, info, depth + 1) for a in expr.args]
+            if any(p.level == BOUNDED for p in provs):
+                return P_BOUNDED
+            out = P_BOUNDED
+            for p in provs:
+                out = join(out, p)
+            return out
+        if resolved == "max":
+            out = P_BOUNDED
+            for a in expr.args:
+                out = join(out, self.prov(a, info, depth + 1))
+            return out
+        if resolved in _PASS_THROUGH and expr.args:
+            return self.prov(expr.args[0], info, depth + 1)
+        target = self._resolve_callee(expr, info)
+        if target is not None:
+            tinfo, fn = target
+            return self._summary(fn, tinfo, depth)
+        return P_UNKNOWN
+
+    @staticmethod
+    def _attr_name(func: ast.AST) -> Optional[str]:
+        return func.attr if isinstance(func, ast.Attribute) else None
+
+    def _resolve_callee(self, call: ast.Call, info):
+        """The called FunctionDef, when statically resolvable: a plain
+        project function through the symbol table, or ``self.m()``
+        within the enclosing class."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            if name_is_shadowed(info, func, func.id):
+                return None
+            hit = self.index.resolve_symbol(info.resolved(func), home=info)
+            if hit is not None and hit[1] is not None:
+                return hit
+            return None
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            cls = self._enclosing_class(call, info)
+            if cls is not None:
+                for sub in cls.body:
+                    if (
+                        isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        )
+                        and sub.name == func.attr
+                    ):
+                        return (info, sub)
+            return None
+        if isinstance(func, ast.Attribute):
+            hit = self.index.resolve_symbol(info.resolved(func), home=info)
+            if hit is not None and hit[1] is not None:
+                return hit
+        return None
+
+    @staticmethod
+    def _enclosing_class(node, info) -> Optional[ast.ClassDef]:
+        cur = info.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = info.parents.get(cur)
+        return None
+
+    # -- interprocedural summaries ------------------------------------------
+
+    def _summary(self, fn, info, depth: int) -> Prov:
+        """Join of a function's return-expression provenances: the
+        callee-side half of interprocedural propagation."""
+        key = id(fn)
+        if key in self._summary_memo:
+            return self._summary_memo[key]
+        token = ("summary", key)
+        if token in self._in_progress:
+            return P_UNKNOWN
+        if isinstance(fn, ast.Lambda):
+            return P_UNKNOWN
+        self._in_progress.add(token)
+        try:
+            out = P_BOUNDED
+            saw_return = False
+            stack = list(ast.iter_child_nodes(fn))
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                    out = P_UNKNOWN
+                    saw_return = True
+                    continue
+                if isinstance(node, ast.Return) and node.value is not None:
+                    saw_return = True
+                    out = join(out, self.prov(node.value, info, depth + 1))
+                stack.extend(ast.iter_child_nodes(node))
+            if not saw_return:
+                out = P_BOUNDED  # returns None
+        finally:
+            self._in_progress.discard(token)
+        self._summary_memo[key] = out
+        return out
+
+    def _param_prov(self, fn, name: str, info, depth: int) -> Prov:
+        """Join over what resolvable project-internal call sites pass
+        for ``fn``'s parameter ``name`` — the caller-side half."""
+        key = (id(fn), name)
+        if key in self._param_memo:
+            return self._param_memo[key]
+        token = ("param", key)
+        if token in self._in_progress:
+            return P_UNKNOWN
+        callers = self._call_sites().get(id(fn), [])
+        if not callers:
+            return P_UNKNOWN
+        pos = _positional_names(fn)
+        self._in_progress.add(token)
+        try:
+            out = P_BOUNDED
+            for cinfo, call in callers:
+                matched = None
+                for i, arg in enumerate(call.args):
+                    if isinstance(arg, ast.Starred):
+                        matched = None
+                        out = P_UNKNOWN
+                        break
+                    if i < len(pos) and pos[i] == name:
+                        matched = arg
+                        break
+                for kw in call.keywords:
+                    if kw.arg == name:
+                        matched = kw.value
+                if matched is None:
+                    continue
+                p = self.prov(matched, cinfo, depth + 1)
+                if p.level == UNBOUNDED:
+                    p = Prov(
+                        UNBOUNDED,
+                        f"{p.origin}, via call at "
+                        f"{cinfo.path}:{call.lineno}",
+                    )
+                out = join(out, p)
+        finally:
+            self._in_progress.discard(token)
+        self._param_memo[key] = out
+        return out
+
+
+def get_dataflow(index) -> DataflowIndex:
+    """Memoized per-:class:`ProjectIndex` dataflow layer (several
+    rules share one index; the field/caller tables are built once)."""
+    df = getattr(index, "_dataflow", None)
+    if df is None:
+        df = DataflowIndex(index)
+        index._dataflow = df
+    return df

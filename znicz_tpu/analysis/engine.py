@@ -30,6 +30,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set
 
 SEVERITIES = ("error", "warning")
 
+# Bumped whenever finding semantics change (new rules, dataflow layer,
+# fingerprint format): the incremental cache and the baseline's
+# staleness check both key on it.
+ANALYZER_VERSION = "2.0"
+
 _PRAGMA_RE = re.compile(
     r"#\s*znicz-check:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)"
 )
@@ -313,7 +318,57 @@ def load_baseline(path: str) -> Counter:
     return Counter(data.get("findings", {}))
 
 
+def baseline_meta(path: str) -> Dict:
+    """The ``analyzer`` stamp a baseline was written under (analyzer
+    version + the rule-id set active at write time).  Empty for a
+    missing file or a pre-versioning baseline — callers treat both as
+    "provenance unknown" and warn."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    meta = data.get("analyzer")
+    return meta if isinstance(meta, dict) else {}
+
+
+def stale_baseline_meta(path: str) -> Optional[str]:
+    """Human-readable staleness verdict for a baseline's analyzer
+    stamp, or None when the stamp matches the active rule set.  A
+    baseline regenerated under an OLDER rule set predates the newer
+    rules' findings: its "clean" verdict silently says nothing about
+    them, so the CLI warns instead of trusting it."""
+    from znicz_tpu.analysis.rules import RULES
+
+    if not os.path.exists(path):
+        return None  # no baseline at all: nothing to mistrust
+    meta = baseline_meta(path)
+    if not meta:
+        return (
+            "baseline has no analyzer stamp (written before rule-set "
+            "versioning); regenerate with --write-baseline"
+        )
+    current = sorted(RULES)
+    recorded = meta.get("rules", [])
+    missing = sorted(set(current) - set(recorded))
+    if missing:
+        return (
+            "baseline predates rule(s) "
+            + ", ".join(missing)
+            + " — its entries were vetted without them; regenerate "
+            "with --write-baseline"
+        )
+    if meta.get("version") != ANALYZER_VERSION:
+        return (
+            f"baseline was written by analyzer "
+            f"{meta.get('version')!r} (current {ANALYZER_VERSION!r}); "
+            "regenerate with --write-baseline"
+        )
+    return None
+
+
 def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    from znicz_tpu.analysis.rules import RULES
+
     counts = Counter(f.fingerprint for f in findings)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:
@@ -324,6 +379,13 @@ def write_baseline(findings: Sequence[Finding], path: str) -> None:
                     "with python -m znicz_tpu.analysis --write-baseline"
                 ),
                 "version": 1,
+                # provenance stamp: which analyzer + rule set vetted
+                # these entries — a later run under a NEWER rule set
+                # warns instead of silently trusting a stale verdict
+                "analyzer": {
+                    "version": ANALYZER_VERSION,
+                    "rules": sorted(RULES),
+                },
                 "findings": {k: counts[k] for k in sorted(counts)},
             },
             f,

@@ -1,0 +1,449 @@
+"""Shared lock model for the serving-tier concurrency rules.
+
+ZNC012 (lock-discipline races) established the per-class picture: which
+attributes are locks, which methods the threads enter.  ZNC015
+(lock-order deadlocks) and ZNC016 (blocking-under-lock) need the step
+further: WHAT HAPPENS WHILE A LOCK IS HELD — which other locks get
+acquired (directly, or transitively through ``self.m()`` calls,
+cross-object ``self.attr.m()`` calls typed from ``__init__``
+assignments, and plain project-function calls through the PR 9 call
+graph), and which recognized blocking operations run inside the
+critical section.  This module computes that once per
+:class:`ProjectIndex` and both rules read it.
+
+Model, per class in the serving tier (``services/`` + ``cluster/`` +
+``observability/``):
+
+* **lock attributes** — ``self.X = threading.Lock()/RLock()/
+  Condition()`` assignments (factory remembered: RLocks are reentrant,
+  so re-acquisition is not a self-deadlock), plus any ``with self.X:``
+  whose attribute name contains "lock" (a lock handed in from outside
+  still declares the discipline).  A lock's identity is
+  ``module.Class.attr`` — two instances of one class share the
+  *ordering discipline* even though they hold distinct lock objects,
+  which is exactly the granularity deadlock cycles care about.
+* **attribute types** — ``self.x = SomeClass(...)`` in any method,
+  with ``SomeClass`` resolved through the module's imports to a
+  serving-tier class: ``self.x.m()`` then resolves to that class's
+  method.
+* **events per callable** — walking each method/function body with the
+  lexical ``with``-held lock stack: lock acquisitions, recognized
+  blocking operations, and calls (with their resolution) are recorded
+  together with the locks held at that point.
+* **summaries** — the set of locks a callable may acquire and the
+  blocking operations it may perform, transitively through resolvable
+  calls (memoized, cycle-guarded).  An edge ``A -> B`` exists when B
+  is acquired (possibly deep in a callee) while A is held.
+
+Approximations, all toward silence: calls on untyped objects
+(parameters, container elements) are invisible; ``lock.acquire()``
+call-form acquisition is not modeled (the repo uses ``with``);
+aliased locks (``self._lock = other._lock``) are treated as distinct
+identities.  Pure stdlib ``ast``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from znicz_tpu.analysis.project import module_name
+
+SERVING_SCOPES = ("/services/", "/cluster/", "/observability/")
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+
+# recognized blocking operations, by fully-resolved dotted name
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep()",
+    "urllib.request.urlopen": "urllib.request.urlopen()",
+    "socket.create_connection": "socket.create_connection()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "os.system": "os.system()",
+    "requests.get": "requests.get()",
+    "requests.post": "requests.post()",
+    "requests.put": "requests.put()",
+    "requests.request": "requests.request()",
+    "open": "open() file I/O",
+    "jax.device_get": "jax.device_get() device sync",
+    "jax.block_until_ready": "jax.block_until_ready() device sync",
+}
+# attribute calls that block regardless of arguments
+_BLOCKING_ATTRS = {
+    "block_until_ready": "device sync .block_until_ready()",
+    "getresponse": "HTTP .getresponse()",
+    "recv": "socket .recv()",
+    "accept": "socket .accept()",
+    "sendall": "socket .sendall()",
+}
+# attribute calls that block when spelled like a synchronization wait
+# (ZNC010's homonym guard: zero positional args, non-module base).
+# A timeout does NOT excuse these here — holding a lock across even a
+# bounded wait stalls every thread needing the lock for that long.
+_WAIT_ATTRS = {"get", "wait", "join"}
+
+
+def in_serving_scope(info) -> bool:
+    path = f"/{info.path}".replace("\\", "/")
+    return any(scope in path for scope in SERVING_SCOPES)
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class LockAcq(NamedTuple):
+    lock: str  # "module.Class.attr"
+    node: ast.AST
+    info: object  # ModuleInfo of the acquisition site
+    via: str  # "" for direct, else the call chain that led here
+
+
+class BlockOp(NamedTuple):
+    desc: str
+    node: ast.AST
+    info: object
+    via: str
+
+
+class _Event(NamedTuple):
+    kind: str  # "acquire" | "block" | "call"
+    payload: object
+    node: ast.AST
+    held: Tuple[str, ...]
+
+
+class _ClassInfo:
+    __slots__ = (
+        "info", "cls", "key", "lock_attrs", "lock_kind", "methods",
+        "attr_types",
+    )
+
+    def __init__(self, info, cls: ast.ClassDef):
+        self.info = info
+        self.cls = cls
+        self.key = f"{module_name(info.path)}.{cls.name}"
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: Set[str] = set()
+        self.lock_kind: Dict[str, str] = {}
+        self.attr_types: Dict[str, str] = {}  # attr -> resolved dotted
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                resolved = info.resolved(node.value.func)
+                for t in node.targets:
+                    attr = self_attr(t)
+                    if attr is None:
+                        continue
+                    kind = _LOCK_FACTORIES.get(resolved or "")
+                    if kind is not None:
+                        self.lock_attrs.add(attr)
+                        self.lock_kind[attr] = kind
+                    elif resolved:
+                        self.attr_types[attr] = resolved
+            elif isinstance(node, ast.AnnAssign):
+                # self.router: Router = router — an annotation types an
+                # attribute the ctor receives instead of constructing
+                attr = self_attr(node.target)
+                ann = node.annotation
+                if isinstance(ann, ast.Constant) and isinstance(
+                    ann.value, str
+                ):
+                    dotted = ann.value
+                elif isinstance(ann, (ast.Name, ast.Attribute)):
+                    dotted = info.resolved(ann)
+                else:
+                    dotted = None
+                if attr and dotted:
+                    self.attr_types[attr] = dotted
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    attr = self_attr(item.context_expr)
+                    if attr and "lock" in attr.lower():
+                        self.lock_attrs.add(attr)
+                        self.lock_kind.setdefault(attr, "unknown")
+
+
+class LockFlow:
+    """The project's lock-order graph + blocking-under-lock events."""
+
+    def __init__(self, index):
+        self.index = index
+        self._by_key: Dict[str, _ClassInfo] = {}
+        for info in index.modules.values():
+            if not in_serving_scope(info):
+                continue
+            for node in info.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    ci = _ClassInfo(info, node)
+                    self._by_key[ci.key] = ci
+        self._events_memo: Dict[int, List[_Event]] = {}
+        self._acq_memo: Dict[int, Dict[str, LockAcq]] = {}
+        self._blk_memo: Dict[int, List[BlockOp]] = {}
+        self._in_progress: Set[int] = set()
+        # every (class, method) pair, for rule iteration
+        self.all_methods: List[Tuple[_ClassInfo, str, ast.AST]] = [
+            (ci, name, fn)
+            for ci in self._by_key.values()
+            for name, fn in ci.methods.items()
+        ]
+
+    # -- resolution ---------------------------------------------------------
+
+    def _class_for(self, dotted: Optional[str]) -> Optional[_ClassInfo]:
+        """A resolved constructor name -> serving-tier class.  Exact
+        dotted key first, then a unique suffix match (``ClassB`` /
+        ``registry.ReplicaRegistry`` spellings); an ambiguous short
+        name resolves to nothing rather than guessing."""
+        if not dotted:
+            return None
+        ci = self._by_key.get(dotted)
+        if ci is not None:
+            return ci
+        matches = [
+            c
+            for key, c in self._by_key.items()
+            if key.endswith("." + dotted)
+        ]
+        return matches[0] if len(matches) == 1 else None
+
+    def _resolve_call(
+        self, call: ast.Call, ci: Optional[_ClassInfo], info
+    ):
+        """-> ("unit", callable_node, owning info, label) or None."""
+        func = call.func
+        attr = self_attr(func)
+        if attr is not None and ci is not None:
+            fn = ci.methods.get(attr)
+            if fn is not None:
+                return (fn, info, f"self.{attr}()", ci)
+            return None
+        # self.x.m(): typed cross-object dispatch
+        if (
+            isinstance(func, ast.Attribute)
+            and ci is not None
+            and (base_attr := self_attr(func.value)) is not None
+        ):
+            dotted = ci.attr_types.get(base_attr)
+            target = self._class_for(dotted)
+            if target is not None:
+                fn = target.methods.get(func.attr)
+                if fn is not None:
+                    return (
+                        fn,
+                        target.info,
+                        f"self.{base_attr}.{func.attr}()",
+                        target,
+                    )
+            return None
+        # plain project function through the symbol table
+        if isinstance(func, (ast.Name, ast.Attribute)):
+            hit = self.index.resolve_symbol(info.resolved(func), home=info)
+            if hit is not None and hit[1] is not None:
+                tinfo, fn = hit
+                label = info.dotted(func) or getattr(func, "attr", "?")
+                return (fn, tinfo, f"{label}()", None)
+        return None
+
+    # -- event extraction ---------------------------------------------------
+
+    def events(self, fn, ci: Optional[_ClassInfo], info) -> List[_Event]:
+        key = id(fn)
+        if key not in self._events_memo:
+            out: List[_Event] = []
+            self._walk(list(fn.body), (), ci, info, out)
+            self._events_memo[key] = out
+        return self._events_memo[key]
+
+    def _lock_id(self, ci: Optional[_ClassInfo], attr: str) -> str:
+        return f"{ci.key}.{attr}" if ci is not None else attr
+
+    def _walk(self, body, held, ci, info, out) -> None:
+        for node in body:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    attr = self_attr(item.context_expr)
+                    if (
+                        attr is not None
+                        and ci is not None
+                        and attr in ci.lock_attrs
+                    ):
+                        lock = self._lock_id(ci, attr)
+                        out.append(
+                            _Event(
+                                "acquire",
+                                lock,
+                                item.context_expr,
+                                new_held,
+                            )
+                        )
+                        new_held = new_held + (lock,)
+                    else:
+                        self._scan_exprs(
+                            [item.context_expr], new_held, ci, info, out
+                        )
+                self._walk(node.body, new_held, ci, info, out)
+                continue
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # nested defs run later, not under this lock
+            children = list(ast.iter_child_nodes(node))
+            # ExceptHandler/match_case are neither stmt nor expr but
+            # CONTAIN statement bodies — route them through the
+            # statement walk or error-path retry/backoff code (exactly
+            # where sleep-under-lock lives) would go invisible
+            stmt_like = (ast.stmt, ast.ExceptHandler, ast.match_case)
+            stmt_children = [
+                c for c in children if isinstance(c, stmt_like)
+            ]
+            expr_children = [
+                c for c in children if not isinstance(c, stmt_like)
+            ]
+            self._scan_exprs(expr_children, held, ci, info, out)
+            if stmt_children:
+                self._walk(stmt_children, held, ci, info, out)
+
+    def _scan_exprs(self, exprs, held, ci, info, out) -> None:
+        stack = list(exprs)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # a with-statement nested inside an expression cannot
+                # occur; guard anyway
+                continue
+            if isinstance(node, ast.Call):
+                desc = self._blocking_desc(node, info)
+                if desc is not None:
+                    out.append(_Event("block", desc, node, held))
+                else:
+                    resolved = self._resolve_call(node, ci, info)
+                    if resolved is not None:
+                        out.append(_Event("call", resolved, node, held))
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _blocking_desc(self, call: ast.Call, info) -> Optional[str]:
+        resolved = info.resolved(call.func)
+        if resolved in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[resolved]
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        if attr in _BLOCKING_ATTRS:
+            return _BLOCKING_ATTRS[attr]
+        if attr in _WAIT_ATTRS and not call.args:
+            base = call.func.value
+            if isinstance(base, ast.Name) and (
+                base.id in info.import_aliases
+                or base.id in info.from_imports
+            ):
+                return None  # module-level homonym (os.wait())
+            if self_attr(call.func) is not None:
+                return None  # self.get()/self.join(): a method, not a wait
+            return f"synchronization .{attr}() wait"
+        return None
+
+    # -- transitive summaries ----------------------------------------------
+
+    def _owner_class(self, fn, info) -> Optional[_ClassInfo]:
+        cur = info.parents.get(fn)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                key = f"{module_name(info.path)}.{cur.name}"
+                return self._by_key.get(key)
+            cur = info.parents.get(cur)
+        return None
+
+    def acquires(self, fn, ci, info, _depth=0) -> Dict[str, LockAcq]:
+        """lock id -> one representative acquisition, transitively."""
+        key = id(fn)
+        if key in self._acq_memo:
+            return self._acq_memo[key]
+        if key in self._in_progress or _depth > 12:
+            return {}
+        self._in_progress.add(key)
+        try:
+            out: Dict[str, LockAcq] = {}
+            for ev in self.events(fn, ci, info):
+                if ev.kind == "acquire":
+                    out.setdefault(
+                        ev.payload, LockAcq(ev.payload, ev.node, info, "")
+                    )
+                elif ev.kind == "call":
+                    cfn, cinfo, label, cci = ev.payload
+                    if cci is None:
+                        cci = self._owner_class(cfn, cinfo)
+                    for lock, acq in self.acquires(
+                        cfn, cci, cinfo, _depth + 1
+                    ).items():
+                        via = label if not acq.via else f"{label} -> {acq.via}"
+                        out.setdefault(
+                            lock, LockAcq(lock, ev.node, info, via)
+                        )
+        finally:
+            self._in_progress.discard(key)
+        self._acq_memo[key] = out
+        return out
+
+    def blocks(self, fn, ci, info, _depth=0) -> List[BlockOp]:
+        """Recognized blocking operations reachable from ``fn``."""
+        key = id(fn)
+        if key in self._blk_memo:
+            return self._blk_memo[key]
+        if key in self._in_progress or _depth > 12:
+            return []
+        self._in_progress.add(key)
+        try:
+            out: List[BlockOp] = []
+            for ev in self.events(fn, ci, info):
+                if ev.kind == "block":
+                    out.append(BlockOp(ev.payload, ev.node, info, ""))
+                elif ev.kind == "call":
+                    cfn, cinfo, label, cci = ev.payload
+                    if cci is None:
+                        cci = self._owner_class(cfn, cinfo)
+                    for op in self.blocks(cfn, cci, cinfo, _depth + 1):
+                        via = label if not op.via else f"{label} -> {op.via}"
+                        out.append(BlockOp(op.desc, ev.node, info, via))
+        finally:
+            self._in_progress.discard(key)
+        self._blk_memo[key] = out
+        return out
+
+    def lock_kind(self, lock_id: str) -> str:
+        cls_key, _, attr = lock_id.rpartition(".")
+        ci = self._by_key.get(cls_key)
+        if ci is None:
+            return "unknown"
+        return ci.lock_kind.get(attr, "unknown")
+
+
+def get_lockflow(index) -> LockFlow:
+    lf = getattr(index, "_lockflow", None)
+    if lf is None:
+        lf = LockFlow(index)
+        index._lockflow = lf
+    return lf

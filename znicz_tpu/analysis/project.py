@@ -119,6 +119,9 @@ class ProjectIndex:
         self._chains: List[_Chain] = []
         # (id(fn)) -> _Chain for entry resolution through nested chains
         self._chain_by_fn: Dict[int, _Chain] = {}
+        self._sites: Optional[
+            Dict[int, List[Tuple[ModuleInfo, ast.Call]]]
+        ] = None
 
     # -- construction ------------------------------------------------------
 
@@ -292,7 +295,10 @@ class ProjectIndex:
         """Project-internal call sites per callee: id(def) ->
         [(caller module, call node)].  Only plain-function calls that
         resolve through the symbol table; ``self.m()`` dispatch and
-        anything dynamic stays invisible (conservative)."""
+        anything dynamic stays invisible (conservative).  Memoized —
+        the chain-marking pass and the dataflow layer share one walk."""
+        if self._sites is not None:
+            return self._sites
         sites: Dict[int, List[Tuple[ModuleInfo, ast.Call]]] = {}
         self._def_meta: Dict[int, Tuple[ModuleInfo, ast.AST]] = {}
         for info in self.modules.values():
@@ -330,6 +336,7 @@ class ProjectIndex:
                     continue  # awaited elsewhere; not a sync chain
                 sites.setdefault(id(fn), []).append((info, node))
                 self._def_meta[id(fn)] = (tinfo, fn)
+        self._sites = sites
         return sites
 
     def _site_traced_params(
@@ -444,6 +451,23 @@ class ProjectIndex:
         return out
 
 
+def project_rule_findings(index: ProjectIndex, rules) -> List[Finding]:
+    """Run the PROJECT rules (``rule.project = True`` — dataflow,
+    lock-order, blocking-under-lock) over a built index.  Suppression
+    applies via the OWNING module's pragmas, exactly like per-module
+    findings; findings anchored in files outside the index (never the
+    case today) pass through unsuppressed."""
+    out: List[Finding] = []
+    for rule in rules:
+        if not getattr(rule, "project", False):
+            continue
+        for finding in rule.project_check(index):
+            info = index.modules.get(finding.path)
+            if info is None or not info.suppressed(finding):
+                out.append(finding)
+    return out
+
+
 def analyze_project(
     paths: Sequence[str],
     *,
@@ -453,7 +477,9 @@ def analyze_project(
 ) -> Tuple[List[Finding], ProjectIndex]:
     """Whole-project analysis: one :class:`ProjectIndex` over every
     ``.py`` under ``paths``, the ordinary rules run per module against
-    the cross-module-marked trees, chain findings re-anchored.
+    the cross-module-marked trees, chain findings re-anchored, then
+    the project rules (dataflow/lock-order/blocking-under-lock) run
+    once over the whole index.
 
     ``report_paths`` (repo-relative, posix) restricts which files'
     findings are RETURNED — the index is still built over everything,
@@ -469,10 +495,13 @@ def analyze_project(
     findings: List[Finding] = list(index.syntax_findings)
     for info in index.modules.values():
         for rule in rules:
+            if getattr(rule, "project", False):
+                continue
             for finding in rule.check(info):
                 if not info.suppressed(finding):
                     findings.append(finding)
     findings = index.relocate(findings)
+    findings.extend(project_rule_findings(index, rules))
     if report_paths is not None:
         findings = [f for f in findings if f.path in report_paths]
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -24,9 +24,30 @@ class Rule:
     id = "ZNC000"
     severity = "error"
     title = "abstract rule"
+    # project rules reason over the WHOLE ProjectIndex (call graph,
+    # dataflow, cross-class lock model) instead of one module at a
+    # time: their ``check`` is a no-op and ``project_check`` does the
+    # work.  ``analyze_project`` runs both kinds; the per-module
+    # ``analyze_source`` path only sees ``check``.
+    project = False
+    # ``--explain`` metadata: a minimal firing example and its
+    # minimally-edited quiet twin.  The registry is the ONE source of
+    # truth — the CLI prints these and the test suite executes them
+    # (fire must fire, quiet must stay quiet).
+    example_fire: str = ""
+    example_quiet: str = ""
+    # path the examples are analyzed under (scoped rules need a
+    # serving-tier path) and sibling files some rules consult
+    example_path: str = "pkg/mod.py"
+    example_support_files: dict = {}
 
     def check(self, info):
+        if self.project:
+            return ()  # needs the project index; see project_check
         raise NotImplementedError
+
+    def project_check(self, index):
+        return ()
 
     def finding(self, info, node, message):
         return info.finding(self.id, self.severity, node, message)
@@ -50,14 +71,17 @@ def get_rules(
 # importing the modules performs registration
 from znicz_tpu.analysis.rules import (  # noqa: E402,F401
     blocking,
+    blocking_lock,
     donation,
     exceptions,
     host_effects,
     host_sync,
     lock_discipline,
+    lock_order,
     metric_names,
     mutable_state,
     prng_keys,
+    recompile_hazard,
     sharding_axes,
     thread_exceptions,
     traced_branch,

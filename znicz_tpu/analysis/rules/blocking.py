@@ -53,6 +53,28 @@ class UnboundedBlockingRule(Rule):
         "a missing one turns a lost wake-up into a permanent hang)"
     )
 
+    example_path = "services/mod.py"
+    example_fire = """
+        import queue
+
+        class Worker:
+            def __init__(self):
+                self.q = queue.Queue()
+
+            def next_item(self):
+                return self.q.get()
+        """
+    example_quiet = """
+        import queue
+
+        class Worker:
+            def __init__(self):
+                self.q = queue.Queue()
+
+            def next_item(self):
+                return self.q.get(timeout=1.0)
+        """
+
     # the serving tier: every package whose threads a hung wait strands
     # a CLIENT in, not just a batch job
     _SCOPES = ("/services/", "/cluster/")

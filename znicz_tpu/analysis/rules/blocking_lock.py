@@ -1,0 +1,120 @@
+"""ZNC016: blocking operations performed while holding a lock.
+
+A serving-tier lock is a convoy waiting to happen: every critical
+section's wall time is paid by EVERY thread that needs the lock — the
+engine tick, the HTTP workers, the watchdog, the metrics pusher.  A
+lock held across a bounded wait stalls the tier for the bound; held
+across an unbounded one (a socket with no timeout, a wedged device
+sync) it turns one slow peer into a fleet-wide hang that the watchdog
+cannot break, because the watchdog's own probe needs the same lock.
+The repo's discipline is "compute under the lock, wait outside it"
+(snapshot state under the lock, then do I/O on the copy).
+
+This rule walks every serving-tier method with the shared lock model
+(:mod:`znicz_tpu.analysis.lockmodel`) and fires when a recognized
+blocking operation runs while any ``with self.<lock>:`` is held —
+directly, or transitively through calls resolved via the PR 9 call
+graph (``self.m()``, typed ``self.attr.m()``, plain project
+functions; the call chain is named in the message).  Recognized
+blocking operations: ``time.sleep``, HTTP/socket calls
+(``urlopen``, ``create_connection``, ``.getresponse()``, ``.recv()``,
+``.accept()``, ``.sendall()``), subprocess spawns, ``open()`` file
+I/O, device syncs (``jax.device_get``, ``.block_until_ready()``), and
+synchronization waits (``.get()``/``.wait()``/``.join()`` in ZNC010's
+homonym-safe shape) — **with or without a timeout**: a bounded wait
+under a lock is still a bounded stall of every other thread.
+
+A deliberate short wait under a lock (rare; say why, and bound it) is
+exempted inline with ``# znicz-check: disable=ZNC016 -- <reason>``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from znicz_tpu.analysis.lockmodel import get_lockflow
+from znicz_tpu.analysis.rules import Rule, register
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "ZNC016"
+    severity = "warning"
+    project = True
+    title = (
+        "blocking operation while holding a serving-tier lock "
+        "(every thread needing the lock stalls for the wait)"
+    )
+
+    example_path = "services/mod.py"
+    example_fire = """
+        import threading
+        import time
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.05)
+                    self.n += 1
+        """
+    example_quiet = """
+        import threading
+        import time
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def tick(self):
+                time.sleep(0.05)
+                with self._lock:
+                    self.n += 1
+        """
+
+    def project_check(self, index) -> Iterable:
+        lf = get_lockflow(index)
+        seen = set()
+        for ci, _name, fn in lf.all_methods:
+            for ev in lf.events(fn, ci, ci.info):
+                if not ev.held:
+                    continue
+                held = ev.held[-1]
+                if ev.kind == "block":
+                    key = (id(ev.node), ev.payload)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield self.finding(
+                        ci.info,
+                        ev.node,
+                        f"{ev.payload} while holding '{held}': every "
+                        "thread needing the lock stalls for the wait; "
+                        "snapshot state under the lock and "
+                        "wait/IO outside it",
+                    )
+                elif ev.kind == "call":
+                    cfn, cinfo, label, cci = ev.payload
+                    if cci is None:
+                        cci = lf._owner_class(cfn, cinfo)
+                    for op in lf.blocks(cfn, cci, cinfo):
+                        chain = (
+                            f"{label} -> {op.via}" if op.via else label
+                        )
+                        key = (id(ev.node), op.desc, chain)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield self.finding(
+                            ci.info,
+                            ev.node,
+                            f"call to {chain} performs {op.desc} while "
+                            f"holding '{held}': every thread needing "
+                            "the lock stalls for the wait; move the "
+                            "blocking work outside the critical "
+                            "section",
+                        )

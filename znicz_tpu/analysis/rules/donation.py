@@ -70,6 +70,23 @@ class DonationRule(Rule):
     severity = "warning"
     title = "jitted train-state function without donate_argnums"
 
+    example_fire = """
+        import jax
+
+        def step(state, batch):
+            return state
+
+        fast = jax.jit(step)
+        """
+    example_quiet = """
+        import jax
+
+        def step(state, batch):
+            return state
+
+        fast = jax.jit(step, donate_argnums=(0,))
+        """
+
     def check(self, info):
         for jc in info.traced.jit_calls:
             if jc.fn is None or jc.has_donation():

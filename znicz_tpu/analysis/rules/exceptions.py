@@ -55,6 +55,25 @@ class SwallowedExceptionRule(Rule):
     severity = "error"
     title = "bare except / silently swallowed exception"
 
+    example_fire = """
+        def probe():
+            try:
+                return 1
+            except Exception:
+                pass
+        """
+    example_quiet = """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def probe():
+            try:
+                return 1
+            except Exception:
+                logger.exception("probe failed")
+        """
+
     def check(self, info):
         for node in ast.walk(info.tree):
             if not isinstance(node, ast.ExceptHandler):

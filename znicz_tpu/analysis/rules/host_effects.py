@@ -38,6 +38,28 @@ class HostEffectRule(Rule):
     severity = "error"
     title = "host-side effect (print/time/io/np) inside jitted code"
 
+    example_fire = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x * t
+        """
+    example_quiet = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2.0
+
+        def run(x):
+            t = time.time()
+            return step(x), t
+        """
+
     def check(self, info):
         for node in ast.walk(info.tree):
             if not isinstance(node, ast.Call):

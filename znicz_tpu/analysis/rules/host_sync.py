@@ -43,6 +43,24 @@ class HostSyncRule(Rule):
     severity = "warning"
     title = "device_get/block_until_ready/time.time inside a host loop"
 
+    example_fire = """
+        import jax
+
+        def losses(batches, acc):
+            out = []
+            for b in batches:
+                out.append(jax.device_get(acc))
+            return out
+        """
+    example_quiet = """
+        import jax
+
+        def losses(batches, acc):
+            for b in batches:
+                pass
+            return jax.device_get(acc)
+        """
+
     def check(self, info):
         for node in ast.walk(info.tree):
             if not isinstance(node, ast.Call):

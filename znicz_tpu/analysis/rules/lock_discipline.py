@@ -309,11 +309,47 @@ class LockDisciplineRule(Rule):
         "multi-threaded serving-tier class"
     )
 
-    _SCOPES = ("/services/", "/cluster/", "/observability/")
+    example_path = "services/mod.py"
+    example_fire = """
+        import threading
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+
+            def submit(self, item):
+                with self._lock:
+                    self._pending.append(item)
+
+            def drain(self):
+                out = list(self._pending)
+                return out
+        """
+    example_quiet = """
+        import threading
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+
+            def submit(self, item):
+                with self._lock:
+                    self._pending.append(item)
+
+            def drain(self):
+                with self._lock:
+                    out = list(self._pending)
+                return out
+        """
 
     def _in_scope(self, info) -> bool:
-        path = f"/{info.path}".replace("\\", "/")
-        return any(scope in path for scope in self._SCOPES)
+        # ONE owner of the serving-tier scope (lockmodel.SERVING_SCOPES)
+        # — a new serving package widens every concurrency rule at once
+        from znicz_tpu.analysis.lockmodel import in_serving_scope
+
+        return in_serving_scope(info)
 
     def check(self, info) -> Iterable:
         if not self._in_scope(info):

@@ -1,0 +1,176 @@
+"""ZNC015: lock-acquisition-order cycles (potential deadlocks).
+
+The serving tier is a web of small locks — the front door's state
+lock, the registry's roster lock, the affinity index's map lock, the
+aggregator's snapshot lock — and threads cross between them: HTTP
+workers into the router, the router into the registry and the
+aggregator, heartbeat probes back into router hooks.  Two threads
+acquiring two locks in OPPOSITE orders is the classic deadlock, and it
+only manifests under load, never in unit tests.
+
+This rule builds the project-wide **lock-order graph** from the shared
+lock model (:mod:`znicz_tpu.analysis.lockmodel`): an edge ``A -> B``
+exists when lock ``B`` is acquired while ``A`` is held — lexically
+(``with self._a: ... with self._b:``) or transitively through calls
+resolved via the PR 9 call graph (``self.m()``, typed cross-object
+``self.attr.m()``, plain project functions).  Lock identity is
+``module.Class.attr``: two instances of one class share the ordering
+discipline, which is the granularity cycles care about.  Any cycle in
+the graph is reported once, with the full path and each edge's
+acquisition site; a self-edge on a non-reentrant lock (``with
+self._lock:`` reaching a method that re-acquires ``self._lock``) is a
+guaranteed SELF-deadlock and is reported too (RLocks are exempt).
+
+Approximations (all toward silence): calls on untyped objects are
+invisible, ``lock.acquire()`` call-form is not modeled, and aliased
+locks are distinct identities.  A deliberate ordering the analysis
+cannot see (e.g. a global total order enforced by sorted acquisition)
+is exempted inline with ``# znicz-check: disable=ZNC015 -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from znicz_tpu.analysis.lockmodel import LockAcq, get_lockflow
+from znicz_tpu.analysis.rules import Rule, register
+
+
+@register
+class LockOrderRule(Rule):
+    id = "ZNC015"
+    severity = "warning"
+    project = True
+    title = (
+        "lock-acquisition-order cycle across serving-tier locks "
+        "(threads interleaving these acquisitions can deadlock)"
+    )
+
+    example_path = "services/mod.py"
+    example_fire = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats_lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    with self._stats_lock:
+                        pass
+
+            def stats(self):
+                with self._stats_lock:
+                    with self._lock:
+                        pass
+        """
+    example_quiet = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats_lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    with self._stats_lock:
+                        pass
+
+            def stats(self):
+                with self._lock:
+                    with self._stats_lock:
+                        pass
+        """
+
+    def project_check(self, index) -> Iterable:
+        lf = get_lockflow(index)
+        # edge (A, B) -> representative (info, node, via) acquisition
+        edges: Dict[Tuple[str, str], LockAcq] = {}
+        for ci, _name, fn in lf.all_methods:
+            for ev in lf.events(fn, ci, ci.info):
+                if not ev.held:
+                    continue
+                acquired: List[LockAcq] = []
+                if ev.kind == "acquire":
+                    acquired = [
+                        LockAcq(ev.payload, ev.node, ci.info, "")
+                    ]
+                elif ev.kind == "call":
+                    cfn, cinfo, label, cci = ev.payload
+                    if cci is None:
+                        cci = lf._owner_class(cfn, cinfo)
+                    acquired = [
+                        LockAcq(a.lock, ev.node, ci.info,
+                                label if not a.via
+                                else f"{label} -> {a.via}")
+                        for a in lf.acquires(cfn, cci, cinfo).values()
+                    ]
+                for acq in acquired:
+                    for held in ev.held:
+                        if acq.lock == held and lf.lock_kind(
+                            held
+                        ) == "rlock":
+                            continue  # reentrant: re-acquisition is fine
+                        edges.setdefault((held, acq.lock), acq)
+        yield from self._report_cycles(edges)
+
+    def _report_cycles(self, edges) -> Iterable:
+        graph: Dict[str, List[str]] = {}
+        for (a, b), _acq in edges.items():
+            graph.setdefault(a, []).append(b)
+        seen_cycles = set()
+        for start in sorted(graph):
+            for cycle in self._cycles_from(start, graph):
+                key = self._canonical(cycle)
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                yield self._cycle_finding(cycle, edges)
+
+    @staticmethod
+    def _cycles_from(start: str, graph) -> Iterable[List[str]]:
+        """Simple cycles through ``start`` (tiny graphs: plain DFS)."""
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    yield path[:]
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + [nxt]))
+
+    @staticmethod
+    def _canonical(cycle: List[str]) -> Tuple[str, ...]:
+        i = cycle.index(min(cycle))
+        return tuple(cycle[i:] + cycle[:i])
+
+    def _cycle_finding(self, cycle: List[str], edges):
+        steps = []
+        first_acq = None
+        for i, lock in enumerate(cycle):
+            nxt = cycle[(i + 1) % len(cycle)]
+            acq = edges[(lock, nxt)]
+            if first_acq is None:
+                first_acq = acq
+            site = f"{acq.info.path}:{getattr(acq.node, 'lineno', 0)}"
+            via = f" via {acq.via}" if acq.via else ""
+            steps.append(f"{lock} -> {nxt} (at {site}{via})")
+        if len(cycle) == 1:
+            message = (
+                f"non-reentrant lock '{cycle[0]}' can be re-acquired "
+                f"while already held ({steps[0]}): a guaranteed "
+                "self-deadlock; use the lock-held-by-caller convention "
+                "or an RLock"
+            )
+        else:
+            message = (
+                "lock-order cycle: "
+                + "; ".join(steps)
+                + " — threads interleaving these acquisitions can "
+                "deadlock; pick one global order (or pragma-exempt "
+                "with the ordering argument)"
+            )
+        return self.finding(first_acq.info, first_acq.node, message)

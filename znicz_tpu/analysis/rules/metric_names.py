@@ -75,6 +75,21 @@ class DynamicMetricNameRule(Rule):
         "the varying value in a label, keep the name static)"
     )
 
+    example_fire = """
+        from znicz_tpu import observability
+
+        def track(kind):
+            observability.counter(f"znicz_{kind}_total").inc()
+        """
+    example_quiet = """
+        from znicz_tpu import observability
+
+        def track(kind):
+            observability.counter(
+                "znicz_events_total", "events"
+            ).labels(kind=kind).inc()
+        """
+
     def check(self, info) -> Iterable:
         for node in ast.walk(info.tree):
             if not isinstance(node, ast.Call):

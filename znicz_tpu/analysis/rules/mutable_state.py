@@ -42,6 +42,19 @@ class MutableStateRule(Rule):
     severity = "warning"
     title = "mutable default arg / mutable module state in jitted closure"
 
+    example_fire = """
+        def collect(x, seen=[]):
+            seen.append(x)
+            return seen
+        """
+    example_quiet = """
+        def collect(x, seen=None):
+            if seen is None:
+                seen = []
+            seen.append(x)
+            return seen
+        """
+
     def check(self, info):
         # (a) mutable default arguments, anywhere
         for fn in ast.walk(info.tree):

@@ -95,6 +95,20 @@ class PrngKeyRule(Rule):
     severity = "warning"
     title = "hard-coded jax.random key / key reuse outside core/prng"
 
+    example_fire = """
+        import jax
+
+        def sample(shape):
+            key = jax.random.PRNGKey(0)
+            return jax.random.normal(key, shape)
+        """
+    example_quiet = """
+        import jax
+
+        def sample(key, shape):
+            return jax.random.normal(key, shape)
+        """
+
     def check(self, info):
         sanctioned = info.path.replace("\\", "/").endswith(
             _SANCTIONED_PATH

@@ -1,0 +1,330 @@
+"""ZNC014: unbounded-dynamic values reaching recompile-sensitive sinks.
+
+The serving stack's hardest-won invariant is **zero new compiled
+programs** under arbitrary request streams (docs/SERVING.md): every
+quantity that becomes a compiled-program identity must be snapped onto
+a small fixed ladder first.  A dozen engine/frontdoor/cluster tests
+pin the invariant at runtime; this rule proves the discipline
+statically, using the dataflow layer's provenance lattice
+(:mod:`znicz_tpu.analysis.dataflow`).
+
+A finding fires when a value classified **unbounded-dynamic**
+(``len(...)``, a wall-clock read, a loop counter, an array ``.size``,
+or anything those taint through assignments, helper returns, call
+arguments and attribute-field stores) reaches one of the
+recompile-sensitive sinks WITHOUT passing a bucketing boundary
+(``bucket_for``, the x2 window/rung helpers, ``min(x, BOUND)``
+clamps, or any helper whose return provenance is bounded):
+
+* a ``static_argnums``/``static_argnames`` argument at a call site of
+  a jit-compiled function (decorator or ``fast = jax.jit(f,
+  static_...)`` call form, resolved cross-module) — each distinct
+  static value IS a new executable;
+* a **program-cache / ladder key**: the key argument of the engines'
+  ``_program``/``_timed_program`` ledger calls, or a subscript
+  store/``setdefault`` into a container whose name contains
+  ``program``/``ladder``/``cache`` — an unbounded key grows the cache
+  (and the compiled-program count it fronts) with the request stream;
+* a host-side **shape constructor**: ``numpy``/``jax.numpy``
+  ``zeros``/``ones``/``full``/``empty``/``arange`` dims or a
+  ``.reshape(...)`` argument — a host buffer sized by request data
+  hands every jit call a fresh shape to compile for.
+
+Sinks inside TRACED code stay quiet (``jnp.zeros(x.shape)`` under jit
+is shape-polymorphic tracing, not a host recompile driver), and only
+definitely-unbounded values fire — unknown provenance never does, so
+config plumbing stays silent.  An intentional per-geometry compile
+(e.g. a cache deliberately keyed by caller-controlled batch size) is
+exempted inline with ``# znicz-check: disable=ZNC014 -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from znicz_tpu.analysis.context import (
+    JIT_WRAPPERS,
+    _positional_names,
+    _static_names_from_kwargs,
+    name_is_shadowed,
+)
+from znicz_tpu.analysis.dataflow import UNBOUNDED, get_dataflow
+from znicz_tpu.analysis.lockmodel import in_serving_scope
+from znicz_tpu.analysis.rules import Rule, register
+
+_LEDGER_CALLS = {"_program", "_timed_program"}
+_CACHE_NAME_RE = re.compile(r"(program|ladder|cache)", re.I)
+_SHAPE_CTORS = {
+    f"{mod}.{fn}"
+    for mod in ("numpy", "jax.numpy")
+    for fn in ("zeros", "ones", "full", "empty", "arange")
+}
+
+
+def _terminal_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+@register
+class RecompileHazardRule(Rule):
+    id = "ZNC014"
+    severity = "warning"
+    project = True
+    title = (
+        "unbounded-dynamic value reaches a compile-identity sink "
+        "(static arg, program-cache key, host shape) without a "
+        "bucketing boundary"
+    )
+
+    example_path = "services/mod.py"
+    example_fire = """
+        programs = {}
+
+        def admit(prompt):
+            key = ("admit", len(prompt))
+            programs[key] = 1
+        """
+    example_quiet = """
+        LADDER = (16, 32, 64)
+        programs = {}
+
+        def bucket_for(n, ladder):
+            for rung in ladder:
+                if n <= rung:
+                    return rung
+            return ladder[-1]
+
+        def admit(prompt):
+            key = ("admit", bucket_for(len(prompt), LADDER))
+            programs[key] = 1
+        """
+
+    # -- static-argument registry -------------------------------------------
+
+    def _static_registry(self, index):
+        """id(fn) -> (fn, static param names) for every resolvable jit
+        application with static args, plus per-module alias maps for
+        ``fast = jax.jit(f, static_...)`` bindings."""
+        static_fns: Dict[int, Tuple[ast.AST, Set[str]]] = {}
+        aliases: Dict[int, Dict[str, Tuple[ast.AST, Set[str]]]] = {}
+        for info in index.modules.values():
+            mod_aliases: Dict[str, Tuple[ast.AST, Set[str]]] = {}
+            for jc in info.traced.jit_calls:
+                if jc.fn is None:
+                    continue
+                names = jc.static_names()
+                if names:
+                    prior = static_fns.get(id(jc.fn))
+                    if prior is not None:
+                        names = names | prior[1]
+                    static_fns[id(jc.fn)] = (jc.fn, names)
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                call = node.value
+                if not isinstance(call, ast.Call):
+                    continue
+                base, kws = info.traced._wrapper_call(call)
+                if base not in JIT_WRAPPERS or not call.args:
+                    continue
+                targets = []
+                for tinfo, fn, _bound in index._resolve_callable(
+                    info, call.args[0]
+                ):
+                    targets.append(fn)
+                for fn, _bound in info.traced._resolve_local(
+                    call.args[0], call
+                ):
+                    targets.append(fn)
+                for fn in targets:
+                    if isinstance(fn, ast.Lambda):
+                        continue
+                    names = _static_names_from_kwargs(fn, kws)
+                    if not names:
+                        continue
+                    prior = static_fns.get(id(fn))
+                    if prior is not None:
+                        names = names | prior[1]
+                    static_fns[id(fn)] = (fn, names)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            mod_aliases[t.id] = (fn, names)
+            if mod_aliases:
+                aliases[id(info)] = mod_aliases
+        return static_fns, aliases
+
+    # -- sink scan ----------------------------------------------------------
+
+    def project_check(self, index) -> Iterable:
+        df = get_dataflow(index)
+        static_fns, aliases = self._static_registry(index)
+        findings = []
+        for info in index.modules.values():
+            findings.extend(
+                self._scan_module(info, index, df, static_fns, aliases)
+            )
+        return findings
+
+    def _fire(self, info, node, prov, sink_desc):
+        return self.finding(
+            info,
+            node,
+            f"unbounded-dynamic value ({prov.origin}) reaches "
+            f"{sink_desc} without passing a bucketing boundary — each "
+            "distinct value compiles (or caches) a new program; snap "
+            "it up a ladder rung (bucket_for / a *_window helper) or "
+            "derive it from static config",
+        )
+
+    def _check_expr(self, expr, info, df, out, node, sink_desc):
+        elts = (
+            expr.elts
+            if isinstance(expr, (ast.Tuple, ast.List))
+            else [expr]
+        )
+        for elt in elts:
+            p = df.prov(elt, info)
+            if p.level == UNBOUNDED:
+                out.append(self._fire(info, node, p, sink_desc))
+                return
+
+    def _scan_module(self, info, index, df, static_fns, aliases):
+        out: List = []
+        mod_aliases = aliases.get(id(info), {})
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Call):
+                if info.traced.in_traced_code(node):
+                    continue  # traced shapes are trace-polymorphism
+                self._scan_call(
+                    node, info, index, df, static_fns, mod_aliases, out
+                )
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                if info.traced.in_traced_code(node):
+                    continue
+                name = _terminal_name(node.value)
+                if _CACHE_NAME_RE.search(name):
+                    self._check_expr(
+                        node.slice,
+                        info,
+                        df,
+                        out,
+                        node,
+                        f"the key of cache/ladder '{name}'",
+                    )
+        return out
+
+    def _scan_call(
+        self, node, info, index, df, static_fns, mod_aliases, out
+    ):
+        func = node.func
+        # 1. program-ledger calls: first arg is the cache key
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _LEDGER_CALLS
+            and node.args
+        ):
+            self._check_expr(
+                node.args[0],
+                info,
+                df,
+                out,
+                node,
+                f"the program-ledger key of .{func.attr}()",
+            )
+            return
+        # 2. .setdefault(key, ...) on cache-named containers
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "setdefault"
+            and node.args
+        ):
+            name = _terminal_name(func.value)
+            if _CACHE_NAME_RE.search(name):
+                self._check_expr(
+                    node.args[0],
+                    info,
+                    df,
+                    out,
+                    node,
+                    f"the key of cache/ladder '{name}'",
+                )
+            return
+        # 3. host-side shape constructors — SERVING tier only: a
+        # loader materializing a dataset-sized host buffer is a
+        # one-time allocation, not a per-request compile driver; the
+        # zero-new-programs contract lives where requests flow
+        if in_serving_scope(info):
+            resolved = info.resolved(func)
+            if resolved in _SHAPE_CTORS and node.args:
+                self._check_expr(
+                    node.args[0],
+                    info,
+                    df,
+                    out,
+                    node,
+                    f"the shape of host-side {resolved}()",
+                )
+                return
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "reshape"
+                and node.args
+            ):
+                for a in node.args:
+                    self._check_expr(
+                        a, info, df, out, node, "a .reshape() dimension"
+                    )
+                return
+        # 4. static arguments at call sites of jit-compiled functions
+        target = None
+        if isinstance(func, ast.Name):
+            if func.id in mod_aliases and not name_is_shadowed(
+                info, func, func.id
+            ):
+                target = mod_aliases[func.id]
+        if target is None and isinstance(func, (ast.Name, ast.Attribute)):
+            if not (
+                isinstance(func, ast.Name)
+                and name_is_shadowed(info, func, func.id)
+            ):
+                hit = index.resolve_symbol(info.resolved(func), home=info)
+                if hit is not None and hit[1] is not None:
+                    entry = static_fns.get(id(hit[1]))
+                    if entry is not None:
+                        target = entry
+        if target is None:
+            return
+        fn, static_names = target
+        pos = _positional_names(fn)
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(pos) and pos[i] in static_names:
+                self._check_expr(
+                    arg,
+                    info,
+                    df,
+                    out,
+                    node,
+                    f"static argument '{pos[i]}' of a jit-compiled "
+                    "function",
+                )
+        for kw in node.keywords:
+            if kw.arg in static_names:
+                self._check_expr(
+                    kw.value,
+                    info,
+                    df,
+                    out,
+                    node,
+                    f"static argument '{kw.arg}' of a jit-compiled "
+                    "function",
+                )

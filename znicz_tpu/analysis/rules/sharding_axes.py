@@ -91,6 +91,20 @@ class ShardingAxisRule(Rule):
     severity = "error"
     title = "PartitionSpec/collective axis name not declared by the mesh"
 
+    example_support_files = {
+        "znicz_tpu/parallel/mesh.py": 'DATA_AXIS = "data"\n'
+    }
+    example_fire = """
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec("bacth")
+        """
+    example_quiet = """
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec("data")
+        """
+
     def __init__(self, axes: Optional[Set[str]] = None):
         self._fixed_axes = axes
         self._axes_by_root = {}

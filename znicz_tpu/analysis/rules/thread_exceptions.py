@@ -187,11 +187,44 @@ class ThreadExceptionSinkRule(Rule):
         "try/except (a thread death must be a typed event)"
     )
 
-    _SCOPES = ("/services/", "/cluster/", "/observability/")
+    example_path = "services/mod.py"
+    example_fire = """
+        import threading
+
+        class Pusher:
+            def start(self, push):
+                self._push = push
+                t = threading.Thread(target=self._loop)
+                t.start()
+
+            def _loop(self):
+                self._push()
+        """
+    example_quiet = """
+        import logging
+        import threading
+
+        logger = logging.getLogger(__name__)
+
+        class Pusher:
+            def start(self, push):
+                self._push = push
+                t = threading.Thread(target=self._loop)
+                t.start()
+
+            def _loop(self):
+                try:
+                    self._push()
+                except Exception:
+                    logger.exception("push failed; thread exiting")
+        """
 
     def _in_scope(self, info) -> bool:
-        path = f"/{info.path}".replace("\\", "/")
-        return any(scope in path for scope in self._SCOPES)
+        # ONE owner of the serving-tier scope (lockmodel.SERVING_SCOPES)
+        # — a new serving package widens every concurrency rule at once
+        from znicz_tpu.analysis.lockmodel import in_serving_scope
+
+        return in_serving_scope(info)
 
     def _resolve_target(self, info, thread_call: ast.Call, expr):
         """The target's FunctionDef/Lambda, or None when not statically

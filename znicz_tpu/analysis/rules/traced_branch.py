@@ -62,6 +62,24 @@ class TracedBranchRule(Rule):
     severity = "error"
     title = "Python if/while on a traced value inside jitted code"
 
+    example_fire = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        """
+    example_quiet = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.where(x > 0, x, -x)
+        """
+
     def check(self, info):
         for node in ast.walk(info.tree):
             if not isinstance(node, (ast.If, ast.While, ast.IfExp)):

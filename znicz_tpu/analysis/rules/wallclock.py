@@ -56,6 +56,23 @@ class WallClockDurationRule(Rule):
         "time.perf_counter() or utils.profiling)"
     )
 
+    example_fire = """
+        import time
+
+        def measure(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0
+        """
+    example_quiet = """
+        import time
+
+        def measure(work):
+            t0 = time.monotonic()
+            work()
+            return time.monotonic() - t0
+        """
+
     def check(self, info) -> Iterable:
         # pass 1: names (function-scoped) and attributes (module-wide —
         # self._t0 is typically set in __init__ and read elsewhere)

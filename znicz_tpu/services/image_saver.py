@@ -64,7 +64,9 @@ class ImageSaver:
         p = np.concatenate(probs)
         y = np.concatenate(labels)
         pred = p.argmax(axis=1)
-        conf = p[np.arange(len(p)), pred]
+        # host-only diagnostic fancy indexing over already-fetched
+        # predictions; the array never feeds a compiled program
+        conf = p[np.arange(len(p)), pred]  # znicz-check: disable=ZNC014
         wrong = pred != y
         out_dir = os.path.join(self.directory, f"epoch{epoch}")
         os.makedirs(out_dir, exist_ok=True)
